@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Headline benchmark — prints ONE JSON line.
+
+The north-star scenario (BASELINE.json / README.md:23-28): DenseNet-121 on
+CIFAR-10, world_size=4, global batch 512, under an induced 3:1 straggler on
+worker 0, DBS on vs off (A/B, as run.sh does). The straggler is delivered as
+real on-device compute (fault_mode='compute'), so epoch wall-clock genuinely
+moves; both arms run the same elastic execution path, so the comparison
+isolates the balancer.
+
+Metric: steady-state epoch wall-clock with DBS on (seconds; lower is better).
+vs_baseline: speedup over the DBS-off arm (>1 means DBS wins).
+
+Environment knobs: BENCH_NTRAIN (default 12800), BENCH_EPOCHS (default 5),
+BENCH_WS (default 4).
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "./.jax_cache")
+
+
+def main() -> int:
+    import numpy as np
+
+    from dynamic_load_balance_distributeddnn_tpu.config import Config
+    from dynamic_load_balance_distributeddnn_tpu.data import load_dataset
+    from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
+    from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+    n_train = int(os.environ.get("BENCH_NTRAIN", 12800))
+    # epoch 0: calibration (no injection); epoch 1: first injected epoch;
+    # 2+: DBS reaction — the minimum meaningful A/B needs 4 on-arm epochs
+    epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
+    ws = int(os.environ.get("BENCH_WS", 4))
+
+    bundle = load_dataset("cifar10", n_train=n_train, n_test=512)
+    factors = [3.0] + [1.0] * (ws - 1)
+
+    def arm(dbs_on: bool, n_epochs: int):
+        cfg = Config(
+            debug=False,
+            world_size=ws,
+            batch_size=512,
+            learning_rate=0.01,
+            epoch_size=n_epochs,
+            dataset="cifar10",
+            model="densenet",
+            dynamic_batch_size=dbs_on,
+            fault_tolerance=True,
+            fault_mode="compute",
+            bucket=32,
+        )
+        tr = Trainer(
+            cfg,
+            bundle=bundle,
+            injector=StaticStragglerInjector(factors, mode="compute"),
+            log_to_file=False,
+        )
+        walls = [tr.run_epoch(e)["epoch_wall"] for e in range(n_epochs)]
+        return walls
+
+    # Epoch 0 of each arm is injection-free (cost calibration) and epoch 1 is
+    # the first injected epoch; steady state is the tail.
+    walls_off = arm(False, max(3, epochs - 2))
+    walls_on = arm(True, epochs)
+    off_steady = float(np.min(walls_off[1:]))
+    on_steady = float(np.min(walls_on[2:]))
+    speedup = off_steady / on_steady
+
+    print(
+        json.dumps(
+            {
+                "metric": "densenet121_cifar10_ws4_3to1straggler_epoch_wallclock",
+                "value": round(on_steady, 4),
+                "unit": "s",
+                "vs_baseline": round(speedup, 4),
+                "detail": {
+                    "dbs_off_epochs_s": [round(w, 4) for w in walls_off],
+                    "dbs_on_epochs_s": [round(w, 4) for w in walls_on],
+                    "n_train": n_train,
+                    "world_size": ws,
+                    "devices": len(__import__("jax").devices()),
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

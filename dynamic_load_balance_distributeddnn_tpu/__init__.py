@@ -1,0 +1,32 @@
+"""Dynamic Load Balance Distributed DNN — TPU-native framework.
+
+A from-scratch JAX/XLA/pjit re-design of the capabilities of
+``Soptq/Dynamic_Load_Balance_DistributedDNN`` ("DBS: Dynamic Batch Size for
+Distributed Deep Neural Network Training", arXiv 2007.11831): synchronous
+data-parallel training where, every epoch, the dataset partition and the
+per-worker batch sizes are re-balanced in inverse proportion to each worker's
+measured compute time, so stragglers receive less work and all workers finish
+each step together.
+
+Where the reference (see /root/reference, cited per-module as file:line) runs
+one Python process per worker over a gloo ring, this framework runs a single
+controller process per host and maps *logical workers* onto the devices of a
+``jax.sharding.Mesh`` — either one worker per chip (the pure SPMD case) or
+several workers time-sharing a chip (the analogue of the reference's
+``-gpu 0,0,0,1`` contention map, README.md:28).
+
+Subpackages
+-----------
+- ``balance``   — the DBS partition solver + per-worker time exchange
+- ``data``      — dataset readers, the dynamic partitioner, LM corpus
+- ``models``    — Flax model zoo (MnistNet, ResNet, DenseNet, GoogLeNet,
+                  RegNet, Transformer LM), GroupNorm throughout
+- ``ops``       — weighted per-example losses, grad utilities, Pallas kernels
+- ``parallel``  — mesh/topology, collectives, ring-attention seq parallelism
+- ``train``     — pjit train steps (fused SPMD + elastic per-worker), engine
+- ``obs``       — logging + the 9-series metrics recorder
+"""
+
+from dynamic_load_balance_distributeddnn_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,17 @@
+from dynamic_load_balance_distributeddnn_tpu.balance.solver import (
+    initial_partition,
+    integer_batch_split,
+    rebalance,
+)
+from dynamic_load_balance_distributeddnn_tpu.balance.timing import (
+    TimeKeeper,
+    exchange_times,
+)
+
+__all__ = [
+    "initial_partition",
+    "integer_batch_split",
+    "rebalance",
+    "TimeKeeper",
+    "exchange_times",
+]

@@ -1,0 +1,100 @@
+"""The DBS partition solver.
+
+Re-derivation of the reference's `get_size` (dbs.py:458-476): given each
+worker's measured compute time ``t_i`` for the last epoch and its current data
+share ``p_i``, the next share is
+
+    r_i = k * p_i / t_i,   k = 1 / sum_j(p_j / t_j)
+
+i.e. each worker's share is scaled by the inverse of its *per-unit-of-data*
+speed: since epoch time t_i ≈ c_i * p_i for per-share cost c_i, the update is
+r_i ∝ 1/c_i — one step straight to the load-balanced fixed point, where every
+worker's epoch takes the same wall-clock.
+
+The real-valued shares are then snapped to an integer split of the global
+batch with the reference's exact rounding rule: floor everything, then award
++1 only to indices that are BOTH among the top-(B - sum_floor) fractional
+remainders AND have remainder >= 0.5 (dbs.py:465-473).  Because of the 0.5
+cutoff the integer sizes may sum to slightly less than B; the returned shares
+are renormalized over the integer split (dbs.py:474), which is what keeps the
+equal-step invariant exact downstream.
+
+This is a pure, deterministic host function: every host/worker computing it on
+the same inputs produces the same plan, so there is no coordinator — the same
+replicated-controller design as the reference (SURVEY §3.2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def initial_partition(world_size: int) -> np.ndarray:
+    """Uniform starting shares (dbs.py:379): all workers presumed equal."""
+    return np.full(world_size, 1.0 / world_size, dtype=np.float64)
+
+
+def integer_batch_split(shares: np.ndarray, global_batch: int) -> np.ndarray:
+    """Snap real-valued shares to integer per-worker batch sizes.
+
+    Implements the floor + (top-k remainders ∩ remainder>=0.5) rule of
+    dbs.py:465-473. Returns an int array; its sum is <= global_batch (equality
+    unless the 0.5 cutoff drops some of the top-k candidates).
+    """
+    shares = np.asarray(shares, dtype=np.float64)
+    ideal = shares * global_batch / shares.sum()
+    floors = np.floor(ideal)
+    remainder = ideal - floors
+    short = int(global_batch - floors.sum())
+    if short > 0:
+        top_k = np.argsort(remainder, kind="stable")[-short:]
+        awarded = top_k[remainder[top_k] >= 0.5]
+        floors[awarded] += 1
+    return floors.astype(np.int64)
+
+
+def rebalance(
+    node_times: np.ndarray,
+    shares: np.ndarray,
+    global_batch: int,
+    max_share: Optional[float] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One DBS update: times + current shares -> (new shares, integer batches).
+
+    ``max_share`` is a TPU-native extension with no reference counterpart: it
+    caps any worker's share (excess redistributed pro-rata) so the padded
+    static-shape fast path has a bounded per-device capacity. Pass ``None``
+    for exact reference behavior.
+    """
+    t = np.asarray(node_times, dtype=np.float64)
+    p = np.asarray(shares, dtype=np.float64)
+    if t.shape != p.shape:
+        raise ValueError("node_times and shares must have the same length")
+    if np.any(t <= 0):
+        raise ValueError("node_times must be positive")
+
+    speed = p / t                       # data processed per second, per worker
+    r = speed / speed.sum()             # == k * p_i / t_i with k = 1/sum(speed)
+
+    if max_share is not None:
+        cap = float(max_share)
+        if cap * len(r) < 1.0:
+            raise ValueError("max_share too small to cover the batch")
+        # Iteratively clamp & redistribute (converges: capped set only grows).
+        for _ in range(len(r)):
+            over = r > cap
+            if not over.any():
+                break
+            excess = (r[over] - cap).sum()
+            r[over] = cap
+            free = ~over
+            r[free] += excess * r[free] / r[free].sum()
+
+    batches = integer_batch_split(r, global_batch)
+    total = batches.sum()
+    if total <= 0:
+        raise ValueError("degenerate split: no worker received any batch")
+    new_shares = batches.astype(np.float64) / float(total)
+    return new_shares, batches

@@ -1,0 +1,86 @@
+"""Per-worker time measurement and exchange.
+
+The reference measures each worker's epoch compute time with wall-clock
+deltas, *excluding* accumulated communication wait (dbs.py:226-250), then ring
+all-gathers the scalar times so every worker can run the solver on an
+identical vector (dbs.py:479-499). That compute/comm split is load-bearing:
+the balancer must react to compute speed, not network jitter (SURVEY §2.4).
+
+Here the controller process dispatches every logical worker's step and blocks
+on each worker's outputs in completion order, so per-worker durations fall out
+of completion timestamps; combine/update (the communication) is timed
+separately. Across hosts, the ring all-gather becomes a host-level
+``process_allgather`` (per-epoch metadata — no reason to burn an ICI
+collective on 8 scalars).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import numpy as np
+
+
+class TimeKeeper:
+    """Accumulates per-worker compute seconds and global comm seconds for one
+    epoch. Not thread-safe; the engine drives it from the controller thread."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.reset()
+
+    def reset(self) -> None:
+        self.compute_s = np.zeros(self.world_size, dtype=np.float64)
+        self.comm_s = 0.0
+        self.injected_s = np.zeros(self.world_size, dtype=np.float64)
+
+    def add_compute(self, worker: int, seconds: float) -> None:
+        self.compute_s[worker] += seconds
+
+    def add_comm(self, seconds: float) -> None:
+        self.comm_s += seconds
+
+    def add_injected(self, worker: int, seconds: float) -> None:
+        """Virtual straggler seconds (fault_mode='virtual'): counted into the
+        time vector the solver sees, mirroring the reference's sleeps being
+        measured into train_time (dbs.py:103, 241)."""
+        self.injected_s[worker] += seconds
+
+    def node_times(self) -> np.ndarray:
+        """The per-worker times fed to the solver: compute + injected, never
+        comm (reference contract, dbs.py:250/425)."""
+        return self.compute_s + self.injected_s
+
+
+def exchange_times(local_times: np.ndarray) -> np.ndarray:
+    """All-gather per-worker times across hosts (reference's time_allreduce
+    ring, dbs.py:479-499). Single-host: identity. Multi-host: each host
+    contributes its local workers' slice; result is rank-ordered like the
+    reference's rotate+reverse step (dbs.py:495-498)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(local_times, dtype=np.float64)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray(local_times, dtype=np.float64)
+    )
+    return np.asarray(gathered).reshape(-1)
+
+
+class StepClock:
+    """Context helper for wall-clock sections with monotonic time."""
+
+    def __init__(self):
+        self._t0 = None
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        return False

@@ -1,0 +1,40 @@
+"""Command-line entry point.
+
+The analogue of ``python dbs.py <flags>`` (dbs.py:527-544): parse the 13
+reference flags (+ TPU extras), skip runs whose rank-0 log already exists
+(idempotence probe, dbs.py:528-534), then run the training engine. No process
+forking — the SPMD controller drives all logical workers from one process per
+host (SURVEY §7.1).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional, Sequence
+
+from dynamic_load_balance_distributeddnn_tpu.config import config_from_args
+from dynamic_load_balance_distributeddnn_tpu.obs.logging import run_already_done
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    cfg = config_from_args(argv)
+    if run_already_done(cfg):
+        print("\n===========================")
+        print("Had finished this experiment, skipping...")
+        print("===========================\n")
+        return 0
+
+    if cfg.model == "transformer":
+        from dynamic_load_balance_distributeddnn_tpu.train.lm_engine import LMTrainer
+
+        trainer = LMTrainer(cfg)
+    else:
+        from dynamic_load_balance_distributeddnn_tpu.train.engine import Trainer
+
+        trainer = Trainer(cfg)
+    trainer.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,175 @@
+"""Run configuration.
+
+Mirrors the reference CLI surface (parser.py:40-80 — 13 flags with the same
+short names, defaults, and coercion rules) and adds TPU-specific knobs that
+have no reference counterpart (bucketing, capacity headroom, fault-injection
+mode, precision). The reference parses at module import into globals
+(dbs.py:22, 32-44); here everything lives in one frozen dataclass that is
+passed explicitly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import List, Optional, Sequence
+
+MODELS = ["mnistnet", "resnet", "densenet", "googlenet", "regnet", "transformer"]
+DATASETS = ["cifar10", "cifar100", "mnist", "wikitext2"]
+
+
+def str2bool(v) -> bool:
+    """Boolean coercion with the reference's accepted spellings (parser.py:8-16)."""
+    if isinstance(v, bool):
+        return v
+    if v.lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if v.lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError("Boolean value expected.")
+
+
+def device_map(v):
+    """Worker→device map: a single device ordinal or a comma list, one entry
+    per worker (the analogue of the reference's `-gpu 0,0,0,1`, parser.py:19-25).
+    """
+    if isinstance(v, int):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [int(g) for g in v]
+    if "," in v:
+        return [int(g) for g in v.split(",")]
+    return int(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # ---- reference-parity flags (parser.py:40-80) ----
+    debug: bool = True                 # -d: tiny CPU-friendly smoke mode
+    world_size: int = 4                # -ws: number of logical workers
+    batch_size: int = 64               # -b: global batch size
+    learning_rate: float = 0.01        # -lr
+    epoch_size: int = 10               # -e
+    dataset: str = "wikitext2"         # -ds
+    dynamic_batch_size: bool = True    # -dbs: the DBS balancer on/off
+    device: object = None              # -gpu analogue: worker→device map;
+                                       # None = round-robin over all devices
+    model: str = "transformer"         # -m
+    fault_tolerance: bool = False      # -ft: straggler injection on/off
+    fault_tolerance_chance: float = 0.1  # -ftc
+    one_cycle_policy: bool = False     # -ocp
+    disable_enhancements: bool = False  # -de: uniform grad weights + no OCP
+
+    # ---- TPU-native knobs (new in this framework) ----
+    seed: int = 1234                   # partitioner/model seed (dbs.py:313, 329)
+    momentum: float = 0.9              # SGD momentum (dbs.py:369)
+    bucket: int = 16                   # batch shapes rounded up to a multiple of
+                                       # this, bounding XLA recompiles while
+                                       # keeping real per-worker compute ∝ batch
+    capacity_factor: float = 2.0       # max worker share = factor/world_size;
+                                       # bounds memory of the padded fast path
+    fault_mode: str = "virtual"        # "virtual": add simulated seconds to the
+                                       # measured time vector (exact reference
+                                       # semantics, dbs.py:94-129);
+                                       # "compute": inject real on-device FLOPs
+    precision: str = "float32"         # "float32" | "bfloat16" compute dtype
+    data_dir: str = "./data"
+    lm_data_dir: str = "./rnn_data/wikitext-2"
+    log_dir: str = "./logs"
+    stat_dir: str = "./statis"
+    ckpt_dir: str = ""                 # non-empty → orbax checkpointing on
+    bptt: int = 35                     # LM window (dbs.py:343)
+    grad_clip: float = 0.0             # LM path uses 0.25 (dbs.py:274)
+    profile_dir: str = ""              # non-empty → jax.profiler traces
+
+    def __post_init__(self):
+        if self.model not in MODELS:
+            raise ValueError(f"invalid model {self.model!r}; choose from {MODELS}")
+        if self.dataset not in DATASETS:
+            raise ValueError(f"invalid dataset {self.dataset!r}; choose from {DATASETS}")
+        if self.world_size < 1:
+            raise ValueError("world_size must be >= 1")
+        if isinstance(self.device, list) and len(self.device) != self.world_size:
+            raise ValueError("device map length must equal world_size")
+        if self.fault_mode not in ("virtual", "compute"):
+            raise ValueError("fault_mode must be 'virtual' or 'compute'")
+
+    @property
+    def num_classes(self) -> int:
+        # dbs.py:333-335
+        return 100 if self.dataset == "cifar100" else 10
+
+    def worker_device_ids(self, n_devices: int) -> List[int]:
+        """Resolve the worker→device map. An int (including 0, like the
+        reference's `-gpu 0`) pins every worker to that device; a list is
+        used verbatim; None (the default) round-robins workers over the
+        available devices (one worker per chip when ws == n_devices)."""
+        if isinstance(self.device, list):
+            return [d % n_devices for d in self.device]
+        if isinstance(self.device, int):
+            return [self.device % n_devices] * self.world_size
+        return [r % n_devices for r in range(self.world_size)]
+
+    def base_filename(self) -> str:
+        """Config-encoded artifact name, same fields as the reference
+        (dbs.py:54-61); `{}` is the worker-rank placeholder."""
+        name = (
+            f"{self.model}-{self.dataset}-debug{int(self.debug)}-n{self.world_size}"
+            f"-bs{self.batch_size}-lr{self.learning_rate:.4f}-ep{self.epoch_size}"
+            f"-dbs{int(self.dynamic_batch_size)}-ft{int(self.fault_tolerance)}"
+            f"-ftc{self.fault_tolerance_chance:f}-node{{}}"
+            f"-ocp{int(self.one_cycle_policy)}"
+        )
+        if self.disable_enhancements:
+            name = "puredbs=" + name
+        return name
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def get_parser() -> argparse.ArgumentParser:
+    """CLI with the reference's 13 flags (same short names/defaults,
+    parser.py:40-80) plus this framework's TPU knobs."""
+    p = argparse.ArgumentParser(
+        description="Dynamic Batch Size for Distributed DNN Training — TPU-native"
+    )
+    d = Config()
+    p.add_argument("-d", "--debug", type=str2bool, default=d.debug,
+                   help="Debug mode: small run on whatever backend is present.")
+    p.add_argument("-ws", "--world_size", type=int, default=d.world_size)
+    p.add_argument("-b", "--batch_size", type=int, default=d.batch_size)
+    p.add_argument("-lr", "--learning_rate", type=float, default=d.learning_rate)
+    p.add_argument("-e", "--epoch_size", type=int, default=d.epoch_size)
+    p.add_argument("-ds", "--dataset", type=str, default=d.dataset, choices=DATASETS)
+    p.add_argument("-dbs", "--dynamic_batch_size", type=str2bool, default=d.dynamic_batch_size)
+    p.add_argument("-gpu", "-dev", "--device", type=device_map, default=None,
+                   help="Worker→device map, e.g. '0,0,0,1', or a single ordinal "
+                        "to pin all workers (reference -gpu). Default: "
+                        "round-robin, one worker per device.")
+    p.add_argument("-m", "--model", type=str, default=d.model, choices=MODELS)
+    p.add_argument("-ft", "--fault_tolerance", type=str2bool, default=d.fault_tolerance)
+    p.add_argument("-ftc", "--fault_tolerance_chance", type=float, default=d.fault_tolerance_chance)
+    p.add_argument("-ocp", "--one_cycle_policy", type=str2bool, default=d.one_cycle_policy)
+    p.add_argument("-de", "--disable_enhancements", type=str2bool, default=d.disable_enhancements)
+    # TPU-native extras
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--momentum", type=float, default=d.momentum)
+    p.add_argument("--bucket", type=int, default=d.bucket)
+    p.add_argument("--capacity_factor", type=float, default=d.capacity_factor)
+    p.add_argument("--fault_mode", type=str, default=d.fault_mode, choices=["virtual", "compute"])
+    p.add_argument("--precision", type=str, default=d.precision, choices=["float32", "bfloat16"])
+    p.add_argument("--data_dir", type=str, default=d.data_dir)
+    p.add_argument("--lm_data_dir", type=str, default=d.lm_data_dir)
+    p.add_argument("--log_dir", type=str, default=d.log_dir)
+    p.add_argument("--stat_dir", type=str, default=d.stat_dir)
+    p.add_argument("--ckpt_dir", type=str, default=d.ckpt_dir)
+    p.add_argument("--bptt", type=int, default=d.bptt)
+    p.add_argument("--grad_clip", type=float, default=d.grad_clip)
+    p.add_argument("--profile_dir", type=str, default=d.profile_dir)
+    return p
+
+
+def config_from_args(argv: Optional[Sequence[str]] = None) -> Config:
+    ns = get_parser().parse_args(argv)
+    return Config(**vars(ns))

@@ -1,0 +1,148 @@
+"""Straggler injection.
+
+The reference's "fault tolerance test" (dbs.py:94-129) randomly slows workers:
+each epoch, a non-waiting worker rolls luck against ``-ftc``; on a hit it
+commits to losing U[5,10] extra seconds per epoch (spread over the epoch's
+steps) for U[4,20] consecutive epochs. "Fault tolerance" means the DBS
+balancer re-routes data away from the injected straggler — graceful
+degradation, not failover (SURVEY §5.3). (The reference's uninitialized
+``saved_epoch`` NameError on first use, dbs.py:109, is fixed here by
+construction.)
+
+Two delivery modes (config.fault_mode):
+
+- ``virtual``: the extra seconds are added to the *measured* time vector fed
+  to the solver, never physically slept. Semantically identical to the
+  reference — its sleeps are simulation too — but deterministic and cheap.
+- ``compute``: converted to real on-device MXU work (ops/faultload.py) at a
+  calibrated seconds-per-iteration rate, so wall-clock genuinely moves — this
+  is the mode benchmarks use.
+
+``StaticStragglerInjector`` provides the induced *profile* version — e.g. the
+README recipe's 3:1 contention (`-gpu 0,0,0,1`, README.md:28) expressed as
+per-worker slowdown factors — used for A/B benchmarking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class EpochFaults:
+    """Per-worker injection plan for one epoch."""
+
+    virtual_seconds: np.ndarray      # [ws] seconds added to the time vector
+    slow_iters_per_step: np.ndarray  # [ws] synthetic-load iters per step
+    time_multipliers: np.ndarray     # [ws] multiplicative factors on measured time
+
+    @classmethod
+    def none(cls, ws: int) -> "EpochFaults":
+        return cls(np.zeros(ws), np.zeros(ws, dtype=np.int64), np.ones(ws))
+
+
+class FaultInjector:
+    def epoch_faults(self, epoch: int, num_batches: int, ctx: "FaultContext") -> EpochFaults:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class FaultContext:
+    """What the engine knows that injectors may need: per-worker true batch
+    sizes and the calibrated conversion rates for compute-mode delivery."""
+
+    batch_sizes: np.ndarray                  # [ws]
+    iter_cost_s: Optional[float] = None      # seconds per synthetic-load iter
+    per_example_cost_s: Optional[np.ndarray] = None  # [ws] clean seconds/example
+
+
+class NullInjector(FaultInjector):
+    def __init__(self, world_size: int):
+        self.ws = world_size
+
+    def epoch_faults(self, epoch, num_batches, ctx):
+        return EpochFaults.none(self.ws)
+
+
+class LuckyFaultInjector(FaultInjector):
+    """Reference-parity random straggler machine (dbs.py:94-129)."""
+
+    def __init__(
+        self,
+        world_size: int,
+        chance: float,
+        mode: str = "virtual",
+        seed: int = 0,
+        logger=None,
+    ):
+        self.ws = world_size
+        self.chance = chance
+        self.mode = mode
+        self.logger = logger
+        # The reference's worker processes use the global `random` unseeded —
+        # independent streams per worker. Here: one seeded stream per worker.
+        self._rngs = [random.Random(seed * 977 + r) for r in range(world_size)]
+        self._waiting = [False] * world_size
+        self._until = [0] * world_size
+        self._wait_s = [0] * world_size
+
+    def epoch_faults(self, epoch, num_batches, ctx):
+        out = EpochFaults.none(self.ws)
+        for r in range(self.ws):
+            if self._waiting[r] and epoch > self._until[r]:
+                self._waiting[r] = False
+            if not self._waiting[r]:
+                luck = self._rngs[r].random()
+                if self.logger:
+                    self.logger.info(
+                        f"Worker {r} got a luck of {luck:.3f}, limit is {self.chance}"
+                    )
+                if luck < self.chance:
+                    # U[5,10] extra seconds/epoch for U[4,20] epochs (dbs.py:120-122)
+                    self._wait_s[r] = self._rngs[r].randint(5, 10)
+                    self._until[r] = epoch + self._rngs[r].randint(4, 20)
+                    self._waiting[r] = True
+                    if self.logger:
+                        self.logger.info(
+                            f"Worker {r} starts to have a {self._wait_s[r]} seconds "
+                            f"more waiting until epoch {self._until[r]}!"
+                        )
+            if self._waiting[r]:
+                secs = float(self._wait_s[r])
+                if self.mode == "compute" and ctx.iter_cost_s:
+                    out.slow_iters_per_step[r] = max(
+                        1, int(round(secs / max(num_batches, 1) / ctx.iter_cost_s))
+                    )
+                else:
+                    out.virtual_seconds[r] = secs
+        return out
+
+
+class StaticStragglerInjector(FaultInjector):
+    """Fixed per-worker slowdown factors — the induced-profile benchmark mode.
+
+    factor f means the worker's per-example cost is f× the clean cost.
+    """
+
+    def __init__(self, factors: Sequence[float], mode: str = "virtual"):
+        self.factors = np.asarray(factors, dtype=np.float64)
+        self.mode = mode
+
+    def epoch_faults(self, epoch, num_batches, ctx):
+        ws = len(self.factors)
+        out = EpochFaults.none(ws)
+        if self.mode == "virtual":
+            out.time_multipliers = self.factors.copy()
+            return out
+        if ctx.iter_cost_s and ctx.per_example_cost_s is not None:
+            extra_s_per_step = (
+                (self.factors - 1.0) * ctx.per_example_cost_s * ctx.batch_sizes
+            )
+            out.slow_iters_per_step = np.maximum(
+                np.round(extra_s_per_step / ctx.iter_cost_s), 0
+            ).astype(np.int64)
+        return out

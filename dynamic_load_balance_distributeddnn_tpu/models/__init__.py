@@ -1,0 +1,60 @@
+"""Flax model zoo.
+
+One family per reference architecture (Net/ directory): MnistNet, ResNet,
+DenseNet, GoogLeNet, RegNet, Transformer LM. All CNNs use GroupNorm — the
+reference's deliberate choice (Net/Resnet.py:11 et al.) because BatchNorm
+statistics would be skewed by unequal per-worker batch sizes; on TPU this also
+avoids cross-replica batch-stat sync. Layout is NHWC (TPU-native).
+
+``build_model(name)`` mirrors the reference's model selection switch
+(dbs.py:345-362): resnet -> ResNet-101, densenet -> DenseNet-121,
+googlenet -> GoogLeNet, regnet -> RegNetY-400MF, plus mnistnet and
+transformer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    module: nn.Module
+    # "logits" -> softmax cross-entropy; "log_probs" -> NLL (dbs.py:371-374)
+    output_kind: str
+    # "image" (NHWC uint8 pipeline) or "tokens" (LM bptt pipeline)
+    input_kind: str
+
+
+def build_model(name: str, num_classes: int = 10, **kw) -> ModelSpec:
+    if name == "mnistnet":
+        from dynamic_load_balance_distributeddnn_tpu.models.mnistnet import MnistNet
+
+        return ModelSpec(name, MnistNet(num_classes=num_classes), "logits", "image")
+    if name == "resnet":
+        from dynamic_load_balance_distributeddnn_tpu.models.resnet import ResNet101
+
+        return ModelSpec(name, ResNet101(num_classes=num_classes), "logits", "image")
+    if name == "densenet":
+        from dynamic_load_balance_distributeddnn_tpu.models.densenet import DenseNet121
+
+        return ModelSpec(name, DenseNet121(num_classes=num_classes), "logits", "image")
+    if name == "googlenet":
+        from dynamic_load_balance_distributeddnn_tpu.models.googlenet import GoogLeNet
+
+        return ModelSpec(name, GoogLeNet(num_classes=num_classes), "logits", "image")
+    if name == "regnet":
+        from dynamic_load_balance_distributeddnn_tpu.models.regnet import RegNetY_400MF
+
+        return ModelSpec(name, RegNetY_400MF(num_classes=num_classes), "logits", "image")
+    if name == "transformer":
+        from dynamic_load_balance_distributeddnn_tpu.models.transformer import (
+            TransformerLM,
+        )
+
+        return ModelSpec(name, TransformerLM(**kw), "log_probs", "tokens")
+    raise ValueError(f"unknown model {name!r}")

@@ -1,0 +1,19 @@
+"""Shared model building blocks."""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+
+
+def group_norm(channels: int, groups: int = 32) -> nn.GroupNorm:
+    """GroupNorm with the reference's group count where it divides the
+    channel count, else the largest divisor of it that does.
+
+    The reference hardcodes GroupNorm(32) (Net/Resnet.py:11 etc.); its
+    RegNetX-200MF config (widths starting at 24, Net/RegNet.py:108-117) would
+    crash under that rule — the gcd fallback keeps every constructor usable
+    while being identical wherever the reference actually runs.
+    """
+    return nn.GroupNorm(num_groups=math.gcd(groups, channels))

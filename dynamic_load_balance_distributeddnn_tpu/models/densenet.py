@@ -1,0 +1,86 @@
+"""DenseNet-BC with GroupNorm (reference: Net/Densenet.py).
+
+Constructors 121/169/201/161 mirror Net/Densenet.py:87-100; `-m densenet`
+selects DenseNet-121 with growth 32 (dbs.py:353) — the model of the canonical
+README recipe and the benchmark north star.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dynamic_load_balance_distributeddnn_tpu.models.common import group_norm
+
+
+class DenseBottleneck(nn.Module):
+    growth_rate: int
+
+    @nn.compact
+    def __call__(self, x):
+        in_planes = x.shape[-1]
+        out = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False)(
+            nn.relu(group_norm(in_planes)(x))
+        )
+        out = nn.Conv(self.growth_rate, (3, 3), padding=1, use_bias=False)(
+            nn.relu(group_norm(4 * self.growth_rate)(out))
+        )
+        # NHWC concat on channels (reference cats on dim 1 in NCHW,
+        # Net/Densenet.py:20)
+        return jnp.concatenate([out, x], axis=-1)
+
+
+class Transition(nn.Module):
+    out_planes: int
+
+    @nn.compact
+    def __call__(self, x):
+        in_planes = x.shape[-1]
+        out = nn.Conv(self.out_planes, (1, 1), use_bias=False)(
+            nn.relu(group_norm(in_planes)(x))
+        )
+        return nn.avg_pool(out, (2, 2), strides=(2, 2))
+
+
+class DenseNet(nn.Module):
+    nblocks: Sequence[int]
+    growth_rate: int = 12
+    reduction: float = 0.5
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        g = self.growth_rate
+        num_planes = 2 * g
+        x = nn.Conv(num_planes, (3, 3), padding=1, use_bias=False)(x)
+        for bi, nblock in enumerate(self.nblocks):
+            for _ in range(nblock):
+                x = DenseBottleneck(growth_rate=g)(x)
+            num_planes += nblock * g
+            if bi != len(self.nblocks) - 1:
+                out_planes = int(math.floor(num_planes * self.reduction))
+                x = Transition(out_planes=out_planes)(x)
+                num_planes = out_planes
+        x = nn.relu(group_norm(num_planes)(x))
+        x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(self.num_classes)(x)
+
+
+def DenseNet121(num_classes=10):
+    return DenseNet((6, 12, 24, 16), growth_rate=32, num_classes=num_classes)
+
+
+def DenseNet169(num_classes=10):
+    return DenseNet((6, 12, 32, 32), growth_rate=32, num_classes=num_classes)
+
+
+def DenseNet201(num_classes=10):
+    return DenseNet((6, 12, 48, 32), growth_rate=32, num_classes=num_classes)
+
+
+def DenseNet161(num_classes=10):
+    return DenseNet((6, 12, 36, 24), growth_rate=48, num_classes=num_classes)

@@ -1,0 +1,74 @@
+"""GoogLeNet / Inception-v1 for CIFAR with GroupNorm (reference:
+Net/GoogleNet.py).
+
+The reference's b3 branch applies GroupNorm(8, n5x5red) BEFORE its 1x1 conv
+(Net/GoogleNet.py:29-30), i.e. to a tensor with `in_planes` channels — a
+channel-count mismatch that crashes at the first forward. Per SURVEY §7.3 the
+rebuild corrects the order (norm after conv, matching branches b1/b2/b4);
+everything else mirrors the reference's stage widths (Net/GoogleNet.py:65-77).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dynamic_load_balance_distributeddnn_tpu.models.common import group_norm
+
+
+def _conv_gn_relu(x, features: int, kernel: int, groups: int):
+    x = nn.Conv(features, (kernel, kernel), padding=kernel // 2)(x)
+    return nn.relu(group_norm(features, groups)(x))
+
+
+class Inception(nn.Module):
+    n1x1: int
+    n3x3red: int
+    n3x3: int
+    n5x5red: int
+    n5x5: int
+    pool_planes: int
+
+    @nn.compact
+    def __call__(self, x):
+        y1 = _conv_gn_relu(x, self.n1x1, 1, 8)
+
+        y2 = _conv_gn_relu(x, self.n3x3red, 1, 8)
+        y2 = _conv_gn_relu(y2, self.n3x3, 3, 16)
+
+        # "5x5" branch implemented as two stacked 3x3s, as in the reference
+        # (Net/GoogleNet.py:32-37); defect-corrected norm placement.
+        y3 = _conv_gn_relu(x, self.n5x5red, 1, 8)
+        y3 = _conv_gn_relu(y3, self.n5x5, 3, 8)
+        y3 = _conv_gn_relu(y3, self.n5x5, 3, 8)
+
+        y4 = nn.max_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
+        y4 = _conv_gn_relu(y4, self.pool_planes, 1, 8)
+
+        return jnp.concatenate([y1, y2, y3, y4], axis=-1)
+
+
+class GoogLeNet(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _conv_gn_relu(x, 192, 3, 8)
+
+        x = Inception(64, 96, 128, 16, 32, 32)(x)
+        x = Inception(128, 128, 192, 32, 96, 64)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        x = Inception(192, 96, 208, 16, 48, 64)(x)
+        x = Inception(160, 112, 224, 24, 64, 64)(x)
+        x = Inception(128, 128, 256, 24, 64, 64)(x)
+        x = Inception(112, 144, 288, 32, 64, 64)(x)
+        x = Inception(256, 160, 320, 32, 128, 128)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        x = Inception(256, 160, 320, 32, 128, 128)(x)
+        x = Inception(384, 192, 384, 48, 128, 128)(x)
+
+        x = nn.avg_pool(x, (8, 8), strides=(1, 1))
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(self.num_classes)(x)

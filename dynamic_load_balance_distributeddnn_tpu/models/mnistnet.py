@@ -1,0 +1,32 @@
+"""MnistNet — the debug-mode CNN (reference: Net/MnistNet.py:9-27).
+
+Two 5x5 valid convs with 2x2 max-pools, dropout, two dense layers. The
+reference emits log_softmax but trains it with cross-entropy anyway
+(dbs.py:374) — a double-log-softmax quirk; here the module emits raw logits
+and the engine applies softmax cross-entropy, which is the equivalent clean
+formulation.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistNet(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        # x: [B, 28, 28, 1] float32
+        x = nn.Conv(10, (5, 5), padding="VALID")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(20, (5, 5), padding="VALID")(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)  # [B, 320]
+        x = nn.relu(nn.Dense(50)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,131 @@
+"""RegNetX/Y with SE blocks and GroupNorm (reference: Net/RegNet.py).
+
+Constructors X_200MF / X_400MF / Y_400MF mirror Net/RegNet.py:108-141;
+`-m regnet` selects RegNetY-400MF (dbs.py:359).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dynamic_load_balance_distributeddnn_tpu.models.common import group_norm
+
+
+class SE(nn.Module):
+    """Squeeze-and-Excitation (Net/RegNet.py:10-23)."""
+
+    se_planes: int
+
+    @nn.compact
+    def __call__(self, x):
+        in_planes = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.relu(nn.Conv(self.se_planes, (1, 1))(s))
+        s = nn.sigmoid(nn.Conv(in_planes, (1, 1))(s))
+        return x * s
+
+
+class RegNetBlock(nn.Module):
+    w_out: int
+    stride: int
+    group_width: int
+    bottleneck_ratio: float
+    se_ratio: float
+
+    @nn.compact
+    def __call__(self, x):
+        w_in = x.shape[-1]
+        w_b = int(round(self.w_out * self.bottleneck_ratio))
+        num_groups = w_b // self.group_width
+
+        out = nn.Conv(w_b, (1, 1), use_bias=False)(x)
+        out = nn.relu(group_norm(w_b)(out))
+        out = nn.Conv(
+            w_b,
+            (3, 3),
+            strides=self.stride,
+            padding=1,
+            feature_group_count=num_groups,
+            use_bias=False,
+        )(out)
+        out = nn.relu(group_norm(w_b)(out))
+        if self.se_ratio > 0:
+            out = SE(se_planes=int(round(w_in * self.se_ratio)))(out)
+        out = nn.Conv(self.w_out, (1, 1), use_bias=False)(out)
+        out = group_norm(self.w_out)(out)
+
+        if self.stride != 1 or w_in != self.w_out:
+            sc = nn.Conv(self.w_out, (1, 1), strides=self.stride, use_bias=False)(x)
+            sc = group_norm(self.w_out)(sc)
+        else:
+            sc = x
+        return nn.relu(out + sc)
+
+
+class RegNet(nn.Module):
+    cfg: Mapping
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(64, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.relu(group_norm(64)(x))
+        for idx in range(4):
+            depth = self.cfg["depths"][idx]
+            width = self.cfg["widths"][idx]
+            stride = self.cfg["strides"][idx]
+            for i in range(depth):
+                x = RegNetBlock(
+                    w_out=width,
+                    stride=stride if i == 0 else 1,
+                    group_width=self.cfg["group_width"],
+                    bottleneck_ratio=self.cfg["bottleneck_ratio"],
+                    se_ratio=self.cfg["se_ratio"],
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def RegNetX_200MF(num_classes=10):
+    return RegNet(
+        dict(
+            depths=[1, 1, 4, 7],
+            widths=[24, 56, 152, 368],
+            strides=[1, 1, 2, 2],
+            group_width=8,
+            bottleneck_ratio=1,
+            se_ratio=0,
+        ),
+        num_classes,
+    )
+
+
+def RegNetX_400MF(num_classes=10):
+    return RegNet(
+        dict(
+            depths=[1, 2, 7, 12],
+            widths=[32, 64, 160, 384],
+            strides=[1, 1, 2, 2],
+            group_width=16,
+            bottleneck_ratio=1,
+            se_ratio=0,
+        ),
+        num_classes,
+    )
+
+
+def RegNetY_400MF(num_classes=10):
+    return RegNet(
+        dict(
+            depths=[1, 2, 7, 12],
+            widths=[32, 64, 160, 384],
+            strides=[1, 1, 2, 2],
+            group_width=16,
+            bottleneck_ratio=1,
+            se_ratio=0.25,
+        ),
+        num_classes,
+    )

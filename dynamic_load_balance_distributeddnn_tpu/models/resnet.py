@@ -1,0 +1,101 @@
+"""CIFAR-style ResNet with GroupNorm (reference: Net/Resnet.py).
+
+GroupNorm instead of BatchNorm is a deliberate reference choice: batch
+statistics would be skewed by DBS's unequal per-worker batch sizes
+(SURVEY §7.2 item 8). Constructors 18/34/50/101/152 mirror
+Net/Resnet.py:91-108; the `-m resnet` selection is ResNet-101 (dbs.py:350).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dynamic_load_balance_distributeddnn_tpu.models.common import group_norm
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        in_planes = x.shape[-1]
+        out = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1, use_bias=False)(x)
+        out = nn.relu(group_norm(self.planes)(out))
+        out = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(out)
+        out = group_norm(self.planes)(out)
+        if self.stride != 1 or in_planes != self.expansion * self.planes:
+            sc = nn.Conv(
+                self.expansion * self.planes, (1, 1), strides=self.stride, use_bias=False
+            )(x)
+            sc = group_norm(self.expansion * self.planes)(sc)
+        else:
+            sc = x
+        return nn.relu(out + sc)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        in_planes = x.shape[-1]
+        out = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        out = nn.relu(group_norm(self.planes)(out))
+        out = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1, use_bias=False)(out)
+        out = nn.relu(group_norm(self.planes)(out))
+        out = nn.Conv(self.expansion * self.planes, (1, 1), use_bias=False)(out)
+        out = group_norm(self.expansion * self.planes)(out)
+        if self.stride != 1 or in_planes != self.expansion * self.planes:
+            sc = nn.Conv(
+                self.expansion * self.planes, (1, 1), strides=self.stride, use_bias=False
+            )(x)
+            sc = group_norm(self.expansion * self.planes)(sc)
+        else:
+            sc = x
+        return nn.relu(out + sc)
+
+
+class ResNet(nn.Module):
+    block: Type[nn.Module]
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(64, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.relu(group_norm(64)(x))
+        for planes, blocks, stride in zip(
+            (64, 128, 256, 512), self.num_blocks, (1, 2, 2, 2)
+        ):
+            for i in range(blocks):
+                x = self.block(planes=planes, stride=stride if i == 0 else 1)(x)
+        x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(self.num_classes)(x)
+
+
+def ResNet18(num_classes=10):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes)
+
+
+def ResNet34(num_classes=10):
+    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes)
+
+
+def ResNet50(num_classes=10):
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes)
+
+
+def ResNet101(num_classes=10):
+    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes)
+
+
+def ResNet152(num_classes=10):
+    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes)

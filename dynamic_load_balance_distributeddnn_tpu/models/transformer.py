@@ -1,0 +1,87 @@
+"""Transformer language model (reference: Net/Transformer.py).
+
+Sinusoidal positional encoding + post-LN encoder stack (the torch
+``nn.TransformerEncoderLayer`` convention the reference relies on) with a
+causal mask, tied to the reference's hyperparameters at the call site:
+emsize=200, nhead=2, nhid=200, nlayers=2, dropout=0.2, bptt=35
+(dbs.py:337-343). Emits log-probabilities, matching the reference's
+log_softmax output + F.nll_loss criterion (Net/Transformer.py:95,
+dbs.py:372).
+
+Layout is batch-major [B, T] (TPU-friendly), vs the reference's [T, B].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def sinusoidal_positions(max_len: int, d_model: int) -> np.ndarray:
+    pos = np.arange(max_len, dtype=np.float32)[:, None]
+    div = np.exp(np.arange(0, d_model, 2, dtype=np.float32) * (-np.log(10000.0) / d_model))
+    pe = np.zeros((max_len, d_model), dtype=np.float32)
+    pe[:, 0::2] = np.sin(pos * div)
+    pe[:, 1::2] = np.cos(pos * div)
+    return pe
+
+
+class EncoderLayer(nn.Module):
+    """Post-LN transformer encoder layer (torch convention)."""
+
+    d_model: int
+    nhead: int
+    d_ff: int
+    dropout: float
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool):
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.nhead,
+            qkv_features=self.d_model,
+            dropout_rate=self.dropout,
+            deterministic=not train,
+        )(x, x, mask=mask)
+        attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
+        x = nn.LayerNorm()(x + attn)
+
+        ff = nn.Dense(self.d_ff)(x)
+        ff = nn.relu(ff)
+        ff = nn.Dropout(self.dropout, deterministic=not train)(ff)
+        ff = nn.Dense(self.d_model)(ff)
+        ff = nn.Dropout(self.dropout, deterministic=not train)(ff)
+        return nn.LayerNorm()(x + ff)
+
+
+class TransformerLM(nn.Module):
+    ntoken: int = 2000
+    ninp: int = 200
+    nhead: int = 2
+    nhid: int = 200
+    nlayers: int = 2
+    dropout: float = 0.2
+    max_len: int = 5000
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        # tokens: [B, T] int32 -> log-probs [B, T, ntoken]
+        b, t = tokens.shape
+        x = nn.Embed(self.ntoken, self.ninp, embedding_init=nn.initializers.uniform(0.2))(
+            tokens
+        )
+        x = x * jnp.sqrt(float(self.ninp))
+        # trace-time constant; folded by XLA, never a trainable parameter
+        pe = jnp.asarray(sinusoidal_positions(min(self.max_len, max(t, 1)), self.ninp))
+        x = x + pe[None, :t, :]
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        causal = nn.make_causal_mask(tokens)
+        for _ in range(self.nlayers):
+            x = EncoderLayer(self.ninp, self.nhead, self.nhid, self.dropout)(
+                x, causal, train
+            )
+        logits = nn.Dense(self.ntoken)(x)
+        return nn.log_softmax(logits, axis=-1)

@@ -1,0 +1,4 @@
+from dynamic_load_balance_distributeddnn_tpu.obs.logging import init_logger
+from dynamic_load_balance_distributeddnn_tpu.obs.recorder import MetricsRecorder
+
+__all__ = ["init_logger", "MetricsRecorder"]

@@ -1,0 +1,58 @@
+"""Run logging.
+
+File + stream logger whose format injects the run's world size, learning rate
+and dbs/ft switches into every line, and whose file name encodes the full
+config — the same observability contract as the reference (dbs_logging.py:5-34,
+filename scheme dbs.py:54-61), minus the per-process fan-out: one controller
+process logs for all logical workers, tagging lines with worker ranks where
+relevant.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+
+_FORMAT = (
+    "%(asctime)s [%(world_size)s:%(lr)s:dbs_%(dbs)s:ft_%(ft)s] "
+    "[%(filename)s:%(lineno)d] %(levelname)s %(message)s"
+)
+
+
+def init_logger(cfg: Config, rank: int = 0, to_file: bool = True) -> logging.LoggerAdapter:
+    extra = {
+        "world_size": cfg.world_size,
+        "lr": cfg.learning_rate,
+        "dbs": "enabled" if cfg.dynamic_batch_size else "disabled",
+        "ft": "enabled" if cfg.fault_tolerance else "disabled",
+    }
+    logger = logging.getLogger(f"{socket.gethostname()}.dbs_tpu")
+    for h in logger.handlers[:]:
+        logger.removeHandler(h)
+    logger.setLevel(logging.DEBUG)
+    logger.propagate = False
+    formatter = logging.Formatter(_FORMAT)
+
+    sh = logging.StreamHandler()
+    sh.setFormatter(formatter)
+    logger.addHandler(sh)
+
+    if to_file:
+        os.makedirs(cfg.log_dir, exist_ok=True)
+        path = os.path.join(cfg.log_dir, cfg.base_filename().format(rank) + ".log")
+        fh = logging.FileHandler(path, "w+")
+        fh.setFormatter(formatter)
+        logger.addHandler(fh)
+
+    return logging.LoggerAdapter(logger, extra)
+
+
+def run_already_done(cfg: Config) -> bool:
+    """Idempotence probe: a completed run leaves its rank-0 log behind
+    (reference behavior, dbs.py:528-534)."""
+    return os.path.isfile(
+        os.path.join(cfg.log_dir, cfg.base_filename().format(0) + ".log")
+    )

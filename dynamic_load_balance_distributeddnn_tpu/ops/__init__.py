@@ -1,0 +1,14 @@
+from dynamic_load_balance_distributeddnn_tpu.ops.losses import (
+    example_weights,
+    per_example_cross_entropy,
+    per_example_nll,
+)
+from dynamic_load_balance_distributeddnn_tpu.ops.augment import augment_images, normalize_images
+
+__all__ = [
+    "example_weights",
+    "per_example_cross_entropy",
+    "per_example_nll",
+    "augment_images",
+    "normalize_images",
+]

@@ -1,0 +1,49 @@
+"""On-device image augmentation.
+
+The reference augments on the host through torchvision transforms
+(RandomCrop(32, padding=4), RandomHorizontalFlip, Normalize —
+dataloader.py:72-77). A per-image Python loop is exactly what a TPU host
+should not be doing, so here the raw uint8 batch is shipped to the device and
+the crop/flip/normalize run inside the jitted train step, vectorized with
+vmap — they fuse into the first conv's input pipeline under XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def normalize_images(x_u8: jnp.ndarray, mean, std) -> jnp.ndarray:
+    """uint8 NHWC -> float32 normalized with dataset stats
+    (dataloader.py:63/76/91)."""
+    x = x_u8.astype(jnp.float32) / 255.0
+    mean = jnp.asarray(mean, dtype=jnp.float32)
+    std = jnp.asarray(std, dtype=jnp.float32)
+    return (x - mean) / std
+
+
+def augment_images(
+    x_u8: jnp.ndarray,
+    rng: jax.Array,
+    mean,
+    std,
+    pad: int = 4,
+    flip: bool = True,
+) -> jnp.ndarray:
+    """Random crop (with ``pad`` px reflection-free zero padding) + horizontal
+    flip + normalize, one independent draw per example."""
+    b, h, w, _ = x_u8.shape
+    k_crop, k_flip = jax.random.split(rng)
+    x = normalize_images(x_u8, mean, std)
+    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    offs = jax.random.randint(k_crop, (b, 2), 0, 2 * pad + 1)
+
+    def crop_one(img, off):
+        return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, img.shape[-1]))
+
+    x = jax.vmap(crop_one)(xp, offs)
+    if flip:
+        do = jax.random.bernoulli(k_flip, 0.5, (b,))
+        x = jnp.where(do[:, None, None, None], x[:, :, ::-1, :], x)
+    return x

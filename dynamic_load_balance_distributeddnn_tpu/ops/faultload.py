@@ -1,0 +1,50 @@
+"""On-device synthetic load for straggler injection (fault_mode='compute').
+
+The reference simulates stragglers with host ``time.sleep`` slices inside the
+step loop (dbs.py:103). In a single-controller SPMD process a host sleep would
+stall *every* worker, so the compute-mode injector instead burns real MXU
+cycles on the target device: a matmul chain whose trip count is a traced
+scalar, so one compiled executable serves every slowdown level
+(``lax.fori_loop`` keeps it a single XLA while loop — no data-dependent Python
+control flow). The chain's output is returned so XLA cannot dead-code it.
+
+``calibrate_iter_cost`` measures seconds/iteration once per backend, letting
+callers convert "this worker should lose S seconds" into an iteration count —
+the same contract as the reference's per-epoch wait seconds.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+_SIZE = 256  # matmul side; big enough to hit the MXU, small enough for VMEM
+
+
+def synthetic_load(n_iters: jnp.ndarray, seed_val: jnp.ndarray) -> jnp.ndarray:
+    """Run ``n_iters`` dependent matmuls; returns a scalar that must be kept
+    live by the caller (e.g. summed into an aux output)."""
+    x = jnp.full((_SIZE, _SIZE), 1e-4, dtype=jnp.float32) + seed_val * 1e-8
+
+    def body(_, acc):
+        return jnp.tanh(acc @ acc) * 0.5 + 0.5
+
+    out = jax.lax.fori_loop(0, n_iters, body, x)
+    return jnp.sum(out) * 1e-12
+
+
+@functools.lru_cache(maxsize=4)
+def calibrate_iter_cost(device_kind: str = "", iters: int = 200) -> float:
+    """Seconds per synthetic-load iteration on the default backend."""
+    fn = jax.jit(synthetic_load)
+    fn(jnp.int32(8), jnp.float32(0)).block_until_ready()  # compile + warm
+    t0 = time.perf_counter()
+    fn(jnp.int32(iters), jnp.float32(0)).block_until_ready()
+    dt_hi = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fn(jnp.int32(1), jnp.float32(0)).block_until_ready()
+    dt_lo = time.perf_counter() - t0
+    return max((dt_hi - dt_lo) / (iters - 1), 1e-9)

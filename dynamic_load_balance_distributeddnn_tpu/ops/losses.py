@@ -1,0 +1,58 @@
+"""Per-example losses and the weighted-gradient contract.
+
+The reference combines worker gradients as sum_r (p_r / sum p) * g_r, where
+g_r is worker r's mean-over-batch gradient and p_r its data share
+(dbs.py:291-301). Here the same math is expressed once, per example: every
+example e carries a weight w_e with sum_e w_e == 1 over the global batch, and
+the combined gradient is the gradient of sum_e w_e * loss_e. Each worker
+differentiates its local partial sum; a plain SUM across workers then
+reproduces the reference's weighted combine exactly:
+
+- DBS mode:  w_e = mask_e / N_total          (=> worker weight = count_r/N = p_r)
+- `-de` mode: w_e = mask_e / (ws * count_r)  (=> worker weight = 1/ws,
+                                              dbs.py:293's degraded branch)
+
+Padding examples get w_e = 0, so the static padded shapes never perturb the
+math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def per_example_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Softmax cross-entropy per example (reference criterion for CNNs,
+    dbs.py:374). logits: [..., C]; labels: [...] int."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32), axis=-1)
+    return logz - gold[..., 0]
+
+
+def per_example_nll(log_probs: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Negative log-likelihood on log-probabilities (reference criterion for
+    the Transformer LM, dbs.py:372)."""
+    gold = jnp.take_along_axis(log_probs, labels[..., None].astype(jnp.int32), axis=-1)
+    return -gold[..., 0]
+
+
+def example_weights(
+    mask: np.ndarray,
+    total_true: int,
+    worker_count: int,
+    world_size: int,
+    uniform_worker_weight: bool = False,
+) -> np.ndarray:
+    """Host-side weight vector for one worker's (padded) batch.
+
+    ``uniform_worker_weight`` selects the `-de` degraded combine
+    (parser.py:77-79, dbs.py:293).
+    """
+    m = mask.astype(np.float32)
+    if uniform_worker_weight:
+        denom = max(worker_count, 1) * world_size
+    else:
+        denom = max(total_true, 1)
+    return m / float(denom)

@@ -1,0 +1,13 @@
+from dynamic_load_balance_distributeddnn_tpu.parallel.topology import WorkerTopology
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+    data_mesh,
+    replicated_sharding,
+    stacked_sharding,
+)
+
+__all__ = [
+    "WorkerTopology",
+    "data_mesh",
+    "replicated_sharding",
+    "stacked_sharding",
+]

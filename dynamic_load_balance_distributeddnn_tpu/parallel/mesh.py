@@ -1,0 +1,55 @@
+"""Mesh construction and shardings.
+
+The distributed backend of this framework is XLA itself: a 1-D ``Mesh`` over
+all chips with a ``data`` axis, gradients combined by XLA collectives over
+ICI/DCN — the TPU-native replacement for the reference's gloo process group
+(dbs.py:511-515; SURVEY §2.4). Multi-host runs call
+``jax.distributed.initialize`` first (the rendezvous analogue of
+MASTER_ADDR/MASTER_PORT env rendezvous, dbs.py:513-514).
+
+The mesh is 1-D today because data parallelism with dynamic shards is the
+reference's only strategy (SURVEY §2.3); the axis name is threaded through
+everything so additional axes (tensor/pipeline/sequence) can be added without
+reshaping the core.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def initialize_multihost(coordinator: Optional[str] = None, **kw) -> None:
+    """Cross-host rendezvous. No-op when single-process."""
+    if jax.process_count() > 1 or coordinator is not None:
+        jax.distributed.initialize(coordinator_address=coordinator, **kw)
+
+
+def data_mesh(devices: Optional[Sequence] = None, axis: str = DATA_AXIS) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def stacked_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Leading axis split across the mesh — used for [n_devices, ...] stacks
+    (per-device gradient partials, sharded batches)."""
+    return NamedSharding(mesh, P(axis))
+
+
+def batch_sharding(
+    mesh: Mesh, ndim: int, axis: str = DATA_AXIS, axis_dim: int = 0
+) -> NamedSharding:
+    """Shard one dimension (``axis_dim``) over the mesh axis, replicate the
+    rest."""
+    spec = [None] * ndim
+    spec[axis_dim] = axis
+    return NamedSharding(mesh, P(*spec))

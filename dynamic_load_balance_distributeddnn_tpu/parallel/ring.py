@@ -1,0 +1,124 @@
+"""Ring attention — sequence/context parallelism over the device mesh.
+
+The reference has no sequence parallelism (its LM path is bptt=35 truncation,
+SURVEY §5.7); this module is the long-context capability built TPU-first: the
+sequence axis is sharded across devices, each device computes blockwise
+attention against the key/value block it currently holds, and blocks rotate
+around the ring with ``lax.ppermute`` over ICI — compute on block i overlaps
+the transfer of block i+1 in XLA's pipeline. Softmax is streamed with the
+numerically-stable running (max, sum, out) accumulation (flash-attention
+style), so no device ever materializes the full [T, T] score matrix.
+
+Use ``ring_self_attention`` inside a ``shard_map`` whose mesh has the
+sequence axis; ``RingAttentionLM`` wires it into the Transformer for
+long-sequence training.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQ_AXIS = "data"  # default: reuse the 1-D mesh; a 2-D mesh can name its own
+
+
+def _block_attn_update(q, k, v, m, l, o, score_mask):
+    """One streaming-softmax update with the current K/V block.
+
+    q: [B, H, Tq, D]; k,v: [B, H, Tk, D]; m,l: [B, H, Tq]; o: [B, H, Tq, D].
+    score_mask: [Tq, Tk] additive (-inf where masked) or None.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if score_mask is not None:
+        s = s + score_mask[None, None]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows (all -inf) from NaNs
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_self_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    q, k, v: local blocks [B, H, T_local, D] (call from inside shard_map).
+    Returns the local output block [B, H, T_local, D].
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    t_local = q.shape[2]
+
+    m = jnp.full(q.shape[:3], -jnp.inf, dtype=q.dtype)
+    l = jnp.zeros(q.shape[:3], dtype=q.dtype)
+    o = jnp.zeros_like(q)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, o = carry
+        src = (my - i) % n  # which shard's keys we currently hold
+        if causal:
+            q_pos = my * t_local + jnp.arange(t_local)
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = jnp.where(
+                q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf
+            ).astype(q.dtype)
+        else:
+            mask = None
+        m, l, o = _block_attn_update(q, k_blk, v_blk, m, l, o, mask)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, o
+
+    carry = (k, v, m, l, o)
+    for i in range(n):  # static trip count: unrolled ring, XLA pipelines it
+        carry = body(i, carry)
+    _, _, m, l, o = carry
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def make_ring_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS, causal: bool = True):
+    """jit-ready global-array wrapper: q,k,v [B, H, T_global, D] sharded on T."""
+
+    fn = jax.shard_map(
+        functools.partial(ring_self_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, axis_name, None),
+            P(None, None, axis_name, None),
+            P(None, None, axis_name, None),
+        ),
+        out_specs=P(None, None, axis_name, None),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def reference_attention(q, k, v, causal: bool = False) -> jnp.ndarray:
+    """Plain full attention (for numerics tests)."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.where(
+            jnp.arange(t)[:, None] >= jnp.arange(t)[None, :], 0.0, -jnp.inf
+        )
+        s = s + mask[None, None]
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)

@@ -1,0 +1,58 @@
+"""Worker topology: logical workers mapped onto physical devices.
+
+The reference forks one OS process per worker and pins each to a GPU from the
+``-gpu`` list — several workers may share a card, which is how the README's
+canonical 3:1 straggler profile arises (`0,0,0,1`: three workers contend on
+GPU 0, dbs.py:518-520, README.md:28). Here the same idea is a pure mapping:
+``world_size`` logical workers assigned to the mesh's devices. Workers that
+share a device have their step computations dispatched back-to-back and the
+XLA runtime serializes them on that chip — contention by construction, no
+processes involved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerTopology:
+    world_size: int
+    devices: Tuple  # jax devices, mesh order
+    worker_device: Tuple[int, ...]  # worker rank -> index into devices
+
+    @classmethod
+    def build(cls, world_size: int, devices: Sequence, device_ids: Sequence[int]) -> "WorkerTopology":
+        if len(device_ids) != world_size:
+            raise ValueError("device_ids must have one entry per worker")
+        n = len(devices)
+        ids = tuple(d % n for d in device_ids)
+        return cls(world_size=world_size, devices=tuple(devices), worker_device=ids)
+
+    @classmethod
+    def round_robin(cls, world_size: int, devices: Sequence) -> "WorkerTopology":
+        return cls.build(world_size, devices, [r % len(devices) for r in range(world_size)])
+
+    def device_of(self, rank: int):
+        return self.devices[self.worker_device[rank]]
+
+    @property
+    def groups(self) -> Dict[int, List[int]]:
+        """device index -> workers on it, in dispatch (rank) order."""
+        g: Dict[int, List[int]] = {}
+        for r, d in enumerate(self.worker_device):
+            g.setdefault(d, []).append(r)
+        return g
+
+    @property
+    def used_device_indices(self) -> List[int]:
+        return sorted(self.groups.keys())
+
+    @property
+    def one_worker_per_device(self) -> bool:
+        return self.world_size == len(self.devices) and len(self.groups) == self.world_size
+
+    def contention_factor(self, rank: int) -> int:
+        """How many workers share this worker's device."""
+        return len(self.groups[self.worker_device[rank]])

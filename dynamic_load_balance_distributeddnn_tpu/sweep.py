@@ -1,0 +1,57 @@
+"""Sweep harness — the run.sh equivalent (run.sh:25-50).
+
+Runs the reference grid {dbs on/off} x {cifar10, cifar100} x
+{resnet, densenet, googlenet, regnet} with OCP enabled, aborting on the first
+failure, each leg idempotently skippable via its rank-0 log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import sys
+
+from dynamic_load_balance_distributeddnn_tpu import cli
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="DBS sweep (run.sh parity)")
+    p.add_argument("-ws", "--world_size", type=int, default=4)
+    p.add_argument("-b", "--batch_size", type=int, default=512)
+    p.add_argument("-e", "--epoch_size", type=int, default=10)
+    p.add_argument("-lr", "--learning_rate", type=float, default=0.01)
+    p.add_argument("-dev", "--device", type=str, default="0")
+    p.add_argument("-de", "--disable_enhancements", type=str, default="false")
+    p.add_argument("--models", type=str, default="resnet,densenet,googlenet,regnet")
+    p.add_argument("--datasets", type=str, default="cifar10,cifar100")
+    ns = p.parse_args(argv)
+
+    grid = itertools.product(
+        ("true", "false"),             # dbs (run.sh:25)
+        ns.datasets.split(","),        # run.sh:27
+        ns.models.split(","),          # run.sh:29
+    )
+    for dbs, dataset, model in grid:
+        args = [
+            "-d", "false",
+            "-ws", str(ns.world_size),
+            "-b", str(ns.batch_size),
+            "-e", str(ns.epoch_size),
+            "-lr", str(ns.learning_rate),
+            "-m", model,
+            "-ds", dataset,
+            "-dbs", dbs,
+            "-gpu", ns.device,
+            "-ocp", "true",
+            "-de", ns.disable_enhancements,
+        ]
+        print(f"==> sweep leg: model={model} dataset={dataset} dbs={dbs}")
+        rc = cli.main(args)
+        if rc != 0:  # fail fast, like run.sh:42-50
+            print(f"sweep leg failed (rc={rc}); aborting")
+            return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,4 @@
+from dynamic_load_balance_distributeddnn_tpu.train.state import TrainState, create_state
+from dynamic_load_balance_distributeddnn_tpu.train.engine import Trainer
+
+__all__ = ["TrainState", "create_state", "Trainer"]

@@ -1,0 +1,70 @@
+"""Checkpoint / resume.
+
+The reference has no model checkpointing at all (SURVEY §5.4) — persistence is
+a rank-0 metrics dump plus a log-file idempotence probe. This module is the
+deliberate capability upgrade: orbax-backed checkpoints of the TrainState plus
+a JSON sidecar with the DBS controller state (shares, node_times, wallclock),
+so a resumed run continues balanced exactly where it left off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _manager(ckpt_dir: str):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        os.path.abspath(ckpt_dir),
+        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
+    )
+
+
+def save_checkpoint(ckpt_dir: str, epoch: int, state, controller: Dict[str, Any]) -> None:
+    """controller: shares / node_times / total_wallclock (JSON-serializable)."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(ckpt_dir)
+    mgr.save(epoch, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    mgr.close()
+    clean = {
+        k: (np.asarray(v).tolist() if not np.isscalar(v) else float(v))
+        for k, v in controller.items()
+    }
+    with open(os.path.join(ckpt_dir, f"controller_{epoch}.json"), "w") as f:
+        json.dump(clean, f)
+
+
+def restore_checkpoint(
+    ckpt_dir: str, state_template
+) -> Optional[Tuple[int, Any, Dict[str, Any]]]:
+    """Returns (last_saved_epoch, state, controller) or None if absent.
+    ``state_template`` is a live TrainState with the target shapes/shardings
+    (the freshly initialized one)."""
+    import orbax.checkpoint as ocp
+
+    if not os.path.isdir(ckpt_dir):
+        return None
+    mgr = _manager(ckpt_dir)
+    step = mgr.latest_step()
+    if step is None:
+        mgr.close()
+        return None
+    abstract = jax.tree_util.tree_map(
+        ocp.utils.to_shape_dtype_struct, state_template
+    )
+    state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    mgr.close()
+    controller: Dict[str, Any] = {}
+    side = os.path.join(ckpt_dir, f"controller_{step}.json")
+    if os.path.exists(side):
+        with open(side) as f:
+            controller = json.load(f)
+    return step, state, controller

@@ -1,0 +1,536 @@
+"""The training engine: the DBS feedback loop.
+
+The reference's per-worker epoch loop (dbs.py:313-446) becomes one controller
+driving all logical workers:
+
+    for epoch:
+        adjust LR (one-cycle)                        dbs.py:386-387
+        shares <- solver(node_times, shares)         dbs.py:388-391
+        plan   <- partition dataset + batch sizes    dbs.py:394-395
+        train one epoch (elastic or fused path)      dbs.py:408-413
+        validate                                     dbs.py:417-421
+        node_times <- per-worker compute times       dbs.py:423-426
+        record the 9 metric series                   dbs.py:428-438
+
+Per-worker compute time on an async SPMD runtime cannot be a naive
+``time.time()`` around a dispatched call (SURVEY §5.1), so the engine times a
+*probe*: one standalone execution of each worker's step (blocking, after
+warm-up), scaled by the worker's step count. Probes inherently include
+compute-mode injected load; virtual-mode injection is added to the vector
+afterwards. Communication (combine+update) is probed separately and never
+enters the solver's time vector — the reference's compute/comm split contract
+(dbs.py:250, 297-299).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamic_load_balance_distributeddnn_tpu.balance import (
+    TimeKeeper,
+    exchange_times,
+    initial_partition,
+    integer_batch_split,
+    rebalance,
+)
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.data import (
+    DatasetBundle,
+    build_epoch_plan,
+    load_dataset,
+)
+from dynamic_load_balance_distributeddnn_tpu.faults import (
+    EpochFaults,
+    FaultContext,
+    FaultInjector,
+    LuckyFaultInjector,
+    NullInjector,
+)
+from dynamic_load_balance_distributeddnn_tpu.models import build_model
+from dynamic_load_balance_distributeddnn_tpu.obs import MetricsRecorder, init_logger
+from dynamic_load_balance_distributeddnn_tpu.ops.faultload import calibrate_iter_cost
+from dynamic_load_balance_distributeddnn_tpu.ops.losses import example_weights
+from dynamic_load_balance_distributeddnn_tpu.parallel import WorkerTopology, data_mesh
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import replicated_sharding
+from dynamic_load_balance_distributeddnn_tpu.train.schedule import one_cycle_lr
+from dynamic_load_balance_distributeddnn_tpu.train.state import create_state, make_optimizer
+from dynamic_load_balance_distributeddnn_tpu.train.steps import (
+    StepLibrary,
+    shard_views,
+    stack_partials,
+)
+
+
+class Trainer:
+    """Vision-model trainer (the Transformer-LM path lives in
+    train/lm_engine.py and shares this controller's balance machinery)."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        bundle: Optional[DatasetBundle] = None,
+        injector: Optional[FaultInjector] = None,
+        logger=None,
+        log_to_file: bool = True,
+        timing_model=None,
+    ):
+        """``timing_model``: optional callable(plan) -> per-worker seconds,
+        replacing wall-clock probes with a deterministic model — used by tests
+        to verify the controller dynamics hermetically (wall-clock on tiny CPU
+        batches is dispatch-overhead-dominated and not ∝ batch size)."""
+        self.cfg = cfg
+        self.timing_model = timing_model
+        self.logger = logger or init_logger(cfg, to_file=log_to_file)
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "multi-host worker topology is not wired up yet: each host "
+                "must own a disjoint worker slice before exchange_times can "
+                "concatenate per-host contributions (balance/timing.py)"
+            )
+        all_devices = jax.devices()
+        device_ids = cfg.worker_device_ids(len(all_devices))
+        used = sorted(set(device_ids))
+        self.topology = WorkerTopology.build(
+            cfg.world_size, [all_devices[i] for i in used], [used.index(i) for i in device_ids]
+        )
+        self.mesh = data_mesh(self.topology.devices)
+        self.n_dev = len(self.topology.devices)
+
+        self._setup_data(bundle)
+        self._setup_model()
+
+        if injector is not None:
+            self.injector = injector
+        elif cfg.fault_tolerance:
+            self.injector = LuckyFaultInjector(
+                cfg.world_size,
+                cfg.fault_tolerance_chance,
+                mode=cfg.fault_mode,
+                seed=cfg.seed,
+                logger=self.logger,
+            )
+        else:
+            self.injector = NullInjector(cfg.world_size)
+        self._needs_iter_cost = cfg.fault_mode == "compute" and not isinstance(
+            self.injector, NullInjector
+        )
+
+        self.recorder = MetricsRecorder()
+        self.shares = initial_partition(cfg.world_size)
+        self.node_times = np.ones(cfg.world_size, dtype=np.float64)
+        self.per_example_cost = np.full(cfg.world_size, np.nan)
+        self.timekeeper = TimeKeeper(cfg.world_size)
+        self.total_wallclock = 0.0
+
+    # -------------------------------------------------------------- set-up
+    # Subclass hooks: the LM trainer (train/lm_engine.py) overrides these.
+
+    def _setup_data(self, bundle: Optional[DatasetBundle]) -> None:
+        cfg = self.cfg
+        if bundle is None:
+            n_cap = 2048 if cfg.debug else None
+            bundle = load_dataset(cfg.dataset, cfg.data_dir, n_train=n_cap, n_test=n_cap)
+        self.bundle = bundle
+        self.n_train = len(bundle.train_x)
+        if bundle.synthetic:
+            self.logger.info(
+                f"dataset {cfg.dataset}: files not found, using the synthetic stand-in"
+            )
+
+    def _setup_model(self) -> None:
+        cfg = self.cfg
+        self.spec = build_model(cfg.model, num_classes=self.bundle.num_classes)
+        self.tx = make_optimizer(cfg.learning_rate, cfg.momentum)
+        h, w, c = self.bundle.train_x.shape[1:]
+        example = jnp.zeros((1, h, w, c), jnp.float32)
+        self.state = create_state(
+            self.spec.module,
+            example,
+            self.tx,
+            seed=cfg.seed,
+            sharding=replicated_sharding(self.mesh),
+        )
+        augment = cfg.dataset in ("cifar10", "cifar100")
+        self.steps = StepLibrary(
+            self.spec,
+            self.mesh,
+            self.tx,
+            mean=self.bundle.mean,
+            std=self.bundle.std,
+            augment=augment,
+            grad_clip=cfg.grad_clip,
+            compute_dtype=jnp.bfloat16 if cfg.precision == "bfloat16" else None,
+        )
+
+    def _build_plan(self, epoch: int, batch_sizes: np.ndarray):
+        return build_epoch_plan(
+            self.n_train,
+            self.shares,
+            batch_sizes,
+            self.cfg.batch_size,
+            epoch,
+            seed=self.cfg.seed,
+            bucket=self.cfg.bucket,
+        )
+
+    # ------------------------------------------------------------------ run
+
+    def run(self, epochs: Optional[int] = None) -> MetricsRecorder:
+        cfg = self.cfg
+        epochs = cfg.epoch_size if epochs is None else epochs
+        self.logger.info(
+            f"Starting: {cfg.model}/{cfg.dataset}, ws={cfg.world_size}, "
+            f"B={cfg.batch_size}, devices={self.n_dev}, dbs={cfg.dynamic_batch_size}"
+        )
+        start_epoch = 0
+        if cfg.ckpt_dir:
+            start_epoch = self._maybe_restore()
+        if cfg.profile_dir:
+            jax.profiler.start_trace(cfg.profile_dir)
+        try:
+            for epoch in range(start_epoch, epochs):
+                self.run_epoch(epoch)
+                if cfg.ckpt_dir:
+                    self._save_checkpoint(epoch)
+        finally:
+            if cfg.profile_dir:
+                jax.profiler.stop_trace()
+        self.recorder.save(cfg.stat_dir, cfg.base_filename())
+        self.logger.info(f"Total wallclock: {self.total_wallclock:.3f}s")
+        return self.recorder
+
+    def _save_checkpoint(self, epoch: int) -> None:
+        from dynamic_load_balance_distributeddnn_tpu.train.checkpoint import (
+            save_checkpoint,
+        )
+
+        save_checkpoint(
+            self.cfg.ckpt_dir,
+            epoch,
+            self.state,
+            {
+                "shares": self.shares,
+                "node_times": self.node_times,
+                "total_wallclock": self.total_wallclock,
+            },
+        )
+
+    def _maybe_restore(self) -> int:
+        from dynamic_load_balance_distributeddnn_tpu.train.checkpoint import (
+            restore_checkpoint,
+        )
+
+        restored = restore_checkpoint(self.cfg.ckpt_dir, self.state)
+        if restored is None:
+            return 0
+        epoch, state, controller = restored
+        self.state = state
+        if "shares" in controller:
+            self.shares = np.asarray(controller["shares"], dtype=np.float64)
+        if "node_times" in controller:
+            self.node_times = np.asarray(controller["node_times"], dtype=np.float64)
+        if "total_wallclock" in controller:
+            self.total_wallclock = float(controller["total_wallclock"])
+        self.logger.info(f"Resumed from checkpoint at epoch {epoch}")
+        return epoch + 1
+
+    def run_epoch(self, epoch: int) -> Dict[str, float]:
+        cfg = self.cfg
+        lr = one_cycle_lr(
+            cfg.learning_rate,
+            epoch,
+            cfg.epoch_size,
+            enabled=cfg.one_cycle_policy,
+            disable_enhancements=cfg.disable_enhancements,
+        )
+        if lr != self.state.learning_rate():
+            self.state = self.state.with_learning_rate(lr)
+
+        if cfg.dynamic_batch_size:
+            max_share = min(1.0, cfg.capacity_factor / cfg.world_size)
+            self.shares, batch_sizes = rebalance(
+                self.node_times, self.shares, cfg.batch_size, max_share=max_share
+            )
+            self.logger.info(
+                f"Epoch {epoch}: adjusted shares to {np.round(self.shares, 4).tolist()}"
+            )
+        else:
+            batch_sizes = integer_batch_split(self.shares, cfg.batch_size)
+
+        plan = self._build_plan(epoch, batch_sizes)
+        self.logger.info(
+            f"Epoch {epoch}: batch sizes {plan.batch_sizes.tolist()}, "
+            f"steps {plan.num_steps}"
+        )
+
+        ctx = FaultContext(
+            batch_sizes=plan.batch_sizes.astype(np.float64),
+            iter_cost_s=calibrate_iter_cost() if self._needs_iter_cost else None,
+            per_example_cost_s=(
+                self.per_example_cost if np.isfinite(self.per_example_cost).all() else None
+            ),
+        )
+        faults = self.injector.epoch_faults(epoch, plan.num_steps, ctx)
+
+        t_epoch = time.perf_counter()
+        if self._can_use_fused(plan):
+            train_metrics = self._train_epoch_fused(plan, faults, epoch)
+        else:
+            train_metrics = self._train_epoch_elastic(plan, faults, epoch)
+        epoch_wall = time.perf_counter() - t_epoch
+        self.total_wallclock += epoch_wall
+
+        val_loss, accuracy = self.validate()
+
+        node_times = (
+            self.timekeeper.compute_s * faults.time_multipliers
+            + self.timekeeper.injected_s
+        )
+        self.node_times = exchange_times(node_times)
+        self.logger.info(
+            f"Epoch {epoch}: node times {np.round(self.node_times, 4).tolist()}, "
+            f"train_loss {train_metrics['loss']:.4f}, val_loss {val_loss:.4f}, "
+            f"accuracy {accuracy:.2f}, wall {epoch_wall:.3f}s"
+        )
+
+        self.recorder.record_epoch(
+            epoch=epoch,
+            train_loss=train_metrics["loss"],
+            train_time=float(self.node_times[0]),
+            sync_time=train_metrics["sync_time"],
+            val_loss=val_loss,
+            accuracy=accuracy,
+            partition=self.shares.tolist(),
+            node_time=self.node_times.tolist(),
+            wallclock_time=self.total_wallclock,
+        )
+        return {
+            "epoch_wall": epoch_wall,
+            "loss": train_metrics["loss"],
+            "val_loss": val_loss,
+            "accuracy": accuracy,
+        }
+
+    # ---------------------------------------------------------- train epoch
+
+    def _can_use_fused(self, plan) -> bool:
+        """The fused whole-epoch SPMD path applies when there is no balancer
+        feedback to measure (dbs off — the reference records node times only
+        under dbs, dbs.py:423-426), the plan is uniform, and workers map 1:1
+        onto mesh devices."""
+        return (
+            not self.cfg.dynamic_batch_size
+            and plan.is_uniform()
+            and self.topology.one_worker_per_device
+            and self.timing_model is None
+            # compute-mode injection needs per-worker probes (elastic path),
+            # so straggler A/B arms stay comparable
+            and not self._needs_iter_cost
+        )
+
+    def _train_epoch_fused(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:
+        cfg = self.cfg
+        self.timekeeper.reset()
+        data = [self._worker_inputs(plan, r) for r in range(cfg.world_size)]
+        # [steps, ws*b_pad, ...] global layout: worker r owns slice r
+        xs = np.concatenate([d[0] for d in data], axis=1)
+        ys = np.concatenate([d[1] for d in data], axis=1)
+        ws_ = np.concatenate([d[2] for d in data], axis=1)
+        from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
+
+        mesh = self.mesh
+        xs = jax.device_put(xs, batch_sharding(mesh, xs.ndim, axis_dim=1))
+        ys = jax.device_put(ys, batch_sharding(mesh, ys.ndim, axis_dim=1))
+        ws_ = jax.device_put(ws_, batch_sharding(mesh, ws_.ndim, axis_dim=1))
+        slow = jax.device_put(
+            faults.slow_iters_per_step.astype(np.int32),
+            batch_sharding(mesh, 1),
+        )
+        self.state, metrics = self.steps.fused_epoch(
+            self.state, xs, ys, ws_, slow, jnp.int32(cfg.seed * 31 + epoch)
+        )
+        metrics = np.asarray(jax.block_until_ready(metrics))
+        for r in range(cfg.world_size):
+            self.timekeeper.add_injected(r, float(faults.virtual_seconds[r]))
+        wloss, loss_sum, count = float(metrics[0]), float(metrics[1]), float(metrics[2])
+        return {
+            "loss": loss_sum / max(count, 1.0),
+            "wloss": wloss / max(plan.num_steps, 1),
+            "sync_time": 0.0,  # comm is fused into the step; not separable
+        }
+
+    def _worker_inputs(self, plan, rank: int):
+        """Materialize one worker's epoch: [steps, b_pad, ...] batches, labels
+        and per-example weights (the weighted-combine contract)."""
+        idx, mask = plan.epoch_indices(rank)
+        x = self.bundle.train_x[idx]
+        y = self.bundle.train_y[idx]
+        w = np.stack(
+            [
+                example_weights(
+                    mask[s],
+                    total_true=int(plan.batch_sizes.sum()),
+                    worker_count=int(mask[s].sum()),
+                    world_size=self.cfg.world_size,
+                    uniform_worker_weight=self.cfg.disable_enhancements,
+                )
+                for s in range(plan.num_steps)
+            ]
+        )
+        return x, y, w
+
+    def _train_epoch_elastic(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:
+        cfg = self.cfg
+        topo = self.topology
+        self.timekeeper.reset()
+
+        data = [self._worker_inputs(plan, r) for r in range(cfg.world_size)]
+        groups = topo.groups
+        dev_order = topo.used_device_indices
+        aux_acc: List = []
+        sync_probe = 0.0
+        base_key = jax.random.PRNGKey(cfg.seed * 7919 + epoch)
+        wkeys = jax.random.split(base_key, cfg.world_size * max(plan.num_steps, 1))
+
+        for s in range(plan.num_steps):
+            partials = {}
+            staged = {}
+            for d in dev_order:
+                dev = topo.devices[d]
+                for r in groups[d]:
+                    x, y, w = data[r]
+                    staged[r] = (
+                        jax.device_put(x[s], dev),
+                        jax.device_put(y[s], dev),
+                        jax.device_put(w[s], dev),
+                        jax.device_put(wkeys[s * cfg.world_size + r], dev),
+                        jax.device_put(
+                            jnp.int32(faults.slow_iters_per_step[r]), dev
+                        ),
+                    )
+            views = shard_views(self.state.params, self.topology.devices)
+            for d in dev_order:
+                acc = None
+                for r in groups[d]:
+                    xs, ys, ws_, key, slow = staged[r]
+                    if acc is None:
+                        acc, aux = self.steps.worker_step_first(
+                            views[d], xs, ys, ws_, key, slow
+                        )
+                    else:
+                        acc, aux = self.steps.worker_step_acc(
+                            views[d], acc, xs, ys, ws_, key, slow
+                        )
+                    aux_acc.append(aux)
+                partials[d] = acc
+
+            stacked = stack_partials([partials[d] for d in dev_order], self.mesh)
+            self.state = self.steps.combine_update(self.state, stacked)
+
+        jax.block_until_ready(self.state.params)
+        # Probe AFTER the epoch's async pipeline has drained, so per-worker
+        # timings measure that worker's executable alone, not queueing noise.
+        # Compute-mode fault injection needs the probes too (per-example cost
+        # calibration), even with the balancer off — otherwise a dbs-off A/B
+        # arm would silently run without its injected straggler.
+        if self.timing_model is None and (
+            cfg.dynamic_batch_size or self._needs_iter_cost
+        ):
+            sync_probe = self._probe_workers(plan, data, faults, epoch)
+        if self.timing_model is not None:
+            modeled = np.asarray(self.timing_model(plan), dtype=np.float64)
+            for r in range(cfg.world_size):
+                self.timekeeper.add_compute(r, modeled[r])
+        self.timekeeper.add_comm(sync_probe * plan.num_steps)
+        for r in range(cfg.world_size):
+            self.timekeeper.add_injected(r, float(faults.virtual_seconds[r]))
+
+        wloss = float(np.sum([float(a[0]) for a in aux_acc]))
+        loss_sum = float(np.sum([float(a[1]) for a in aux_acc]))
+        count = float(np.sum([float(a[2]) for a in aux_acc]))
+        return {
+            "loss": loss_sum / max(count, 1.0),
+            "wloss": wloss / max(plan.num_steps, 1),
+            "sync_time": sync_probe * plan.num_steps,
+        }
+
+    def _probe_workers(
+        self, plan, data, faults: EpochFaults, epoch: int, reps: int = 2
+    ) -> float:
+        """Time each worker's step standalone (blocking, min over ``reps``)
+        plus one combine — the balancer's signal. Called after the epoch's
+        dispatch queue has drained, with executables warm."""
+        topo = self.topology
+        cfg = self.cfg
+        key = jax.random.PRNGKey(cfg.seed * 104729 + epoch)
+        views = shard_views(self.state.params, topo.devices)
+        partials = {}
+        for d in topo.used_device_indices:
+            dev = topo.devices[d]
+            acc = None
+            for r in topo.groups[d]:
+                x, y, w = data[r]
+                xs = jax.device_put(x[0], dev)
+                ys = jax.device_put(y[0], dev)
+                ws_ = jax.device_put(w[0], dev)
+                k = jax.device_put(key, dev)
+                slow = jax.device_put(jnp.int32(faults.slow_iters_per_step[r]), dev)
+                jax.block_until_ready((xs, ys, ws_))
+                # probe with the non-donating first-step executable so reps
+                # are safe; each worker is measured standalone
+                dt = float("inf")
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    acc, aux = self.steps.worker_step_first(
+                        views[d], xs, ys, ws_, k, slow
+                    )
+                    jax.block_until_ready(aux)
+                    dt = min(dt, time.perf_counter() - t0)
+                w_plan = plan.workers[r]
+                self.timekeeper.add_compute(r, dt * w_plan.steps)
+                clean = dt - float(faults.slow_iters_per_step[r]) * (
+                    calibrate_iter_cost() if self._needs_iter_cost else 0.0
+                )
+                self.per_example_cost[r] = max(clean, 1e-9) / max(w_plan.batch_size, 1)
+            partials[d] = acc
+        stacked = stack_partials(
+            [partials[d] for d in topo.used_device_indices], self.mesh
+        )
+        t0 = time.perf_counter()
+        probed = self.steps.combine_probe(self.state, stacked)
+        jax.block_until_ready(probed.params)
+        return time.perf_counter() - t0
+
+    # ------------------------------------------------------------- validate
+
+    def validate(self, batch: int = 1024) -> "tuple[float, float]":
+        """Full-test-set loss/accuracy (reference validate, dbs.py:141-161 —
+        evaluated once, not redundantly per rank; same math)."""
+        xs, ys = self.bundle.test_x, self.bundle.test_y
+        n = len(xs)
+        views = shard_views(self.state.params, self.topology.devices)
+        dev = self.topology.devices[0]
+        loss_sum = correct = count = 0.0
+        for lo in range(0, n, batch):
+            hi = min(lo + batch, n)
+            pad = batch - (hi - lo)
+            xb = np.pad(xs[lo:hi], ((0, pad),) + ((0, 0),) * (xs.ndim - 1))
+            yb = np.pad(ys[lo:hi], (0, pad))
+            mb = np.zeros(batch, dtype=np.float32)
+            mb[: hi - lo] = 1.0
+            ls, cr, ct = self.steps.eval_step(
+                views[0],
+                jax.device_put(xb, dev),
+                jax.device_put(yb, dev),
+                jax.device_put(mb, dev),
+            )
+            loss_sum += float(ls)
+            correct += float(cr)
+            count += float(ct)
+        return loss_sum / max(count, 1.0), 100.0 * correct / max(count, 1.0)

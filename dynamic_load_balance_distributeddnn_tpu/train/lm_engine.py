@@ -1,0 +1,185 @@
+"""Transformer-LM trainer — the sequence workload path.
+
+Shares the DBS controller (solver, timing, faults, recorder) with the vision
+Trainer; differs in the data plane, mirroring the reference's transformer
+branch (dbs.py:253-288, 397-419; dataloader.py:100-110):
+
+- the token *stream* is split contiguously by worker share (no shuffle,
+  dataloader.py:106) and each worker folds its slice into
+  ``bsz_r = share_r * B`` columns (batchify),
+- steps consume bptt=35-token windows with next-token targets (utils.py:7-10),
+- per-worker gradients are clipped to 0.25 before combining (dbs.py:274),
+- validation is bptt-windowed NLL with eval batch 10 (dataloader.py:109) and
+  "accuracy" defined as ``1 - val_loss`` (dbs.py:180-181 — the reference's
+  convention, kept for series parity).
+
+Because worker slice length and column count are both proportional to the
+share, every worker sweeps the same number of windows — the equal-step
+invariant again, now in token space.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamic_load_balance_distributeddnn_tpu.data.corpus import (
+    Corpus,
+    batchify,
+    bptt_windows,
+)
+from dynamic_load_balance_distributeddnn_tpu.data.partitioner import (
+    EpochPlan,
+    WorkerPlan,
+    partition_indices,
+)
+from dynamic_load_balance_distributeddnn_tpu.models import build_model
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import replicated_sharding
+from dynamic_load_balance_distributeddnn_tpu.train.engine import Trainer
+from dynamic_load_balance_distributeddnn_tpu.train.state import create_state, make_optimizer
+from dynamic_load_balance_distributeddnn_tpu.train.steps import StepLibrary, shard_views
+
+
+class LMTrainer(Trainer):
+    # Reference LM hyperparameters (dbs.py:337-343)
+    EMSIZE = 200
+    NHEAD = 2
+    NHID = 200
+    NLAYERS = 2
+    DROPOUT = 0.2
+
+    def _setup_data(self, bundle) -> None:
+        cfg = self.cfg
+        if bundle is not None:
+            self.corpus = bundle  # tests may inject a Corpus directly
+        else:
+            self.corpus = Corpus(cfg.lm_data_dir)
+        for note in getattr(self.corpus, "notes", []):
+            self.logger.warning(f"corpus: {note}")
+        stream = self.corpus.train
+        if cfg.debug and len(stream) > 60_000:
+            stream = stream[:60_000]
+        self.train_stream = stream
+        self.n_train = len(stream)
+        self.bundle = None
+
+    def _setup_model(self) -> None:
+        cfg = self.cfg
+        self.spec = build_model(
+            "transformer",
+            ntoken=self.corpus.ntokens,
+            ninp=self.EMSIZE,
+            nhead=self.NHEAD,
+            nhid=self.NHID,
+            nlayers=self.NLAYERS,
+            dropout=self.DROPOUT,
+        )
+        self.tx = make_optimizer(cfg.learning_rate, cfg.momentum)
+        example = jnp.zeros((1, cfg.bptt), jnp.int32)
+        self.state = create_state(
+            self.spec.module,
+            example,
+            self.tx,
+            seed=cfg.seed,
+            sharding=replicated_sharding(self.mesh),
+        )
+        grad_clip = cfg.grad_clip if cfg.grad_clip > 0 else 0.25  # dbs.py:274
+        self.steps = StepLibrary(
+            self.spec,
+            self.mesh,
+            self.tx,
+            grad_clip=grad_clip,
+            compute_dtype=jnp.bfloat16 if cfg.precision == "bfloat16" else None,
+        )
+
+    # ------------------------------------------------------------- planning
+
+    def _build_plan(self, epoch: int, batch_sizes: np.ndarray) -> EpochPlan:
+        """LM plan: contiguous stream slices; a worker's "batch size" is its
+        column count; steps = number of bptt windows of its folded slice."""
+        cfg = self.cfg
+        parts = partition_indices(self.n_train, self.shares, shuffle=False)
+        workers = []
+        num_steps = 0
+        for rank, (token_range, cols) in enumerate(zip(parts, batch_sizes)):
+            cols = int(max(cols, 1))
+            nbatch = max(len(token_range) // cols, 2)
+            steps = max(-(-(nbatch - 1) // cfg.bptt), 1)
+            padded = -(-cols // cfg.bucket) * cfg.bucket
+            workers.append(
+                WorkerPlan(
+                    rank=rank,
+                    indices=token_range,
+                    batch_size=cols,
+                    padded_batch=padded,
+                    steps=steps,
+                )
+            )
+            num_steps = max(num_steps, steps)
+        return EpochPlan(
+            epoch=epoch,
+            shares=self.shares.copy(),
+            batch_sizes=np.asarray(batch_sizes, dtype=np.int64),
+            workers=tuple(workers),
+            num_steps=num_steps,
+            global_batch=cfg.batch_size,
+        )
+
+    def _worker_inputs(self, plan: EpochPlan, rank: int):
+        cfg = self.cfg
+        w = plan.workers[rank]
+        if len(w.indices):
+            slice_tokens = self.train_stream[w.indices[0] : w.indices[-1] + 1]
+        else:
+            slice_tokens = np.zeros(0, dtype=np.int32)
+        data = batchify(slice_tokens, w.batch_size)
+        x, y, m = bptt_windows(data, cfg.bptt, pad_bsz=w.padded_batch)
+        # pad the step axis to the plan-wide count with fully masked windows
+        if x.shape[0] < plan.num_steps:
+            extra = plan.num_steps - x.shape[0]
+            zpad = ((0, extra), (0, 0), (0, 0))
+            x, y, m = (np.pad(a, zpad) for a in (x, y, m))
+        # Per-token weights: worker weight p_r (or 1/ws under -de) spread over
+        # the window's true token count — sum over all workers == 1.
+        p_r = (
+            1.0 / cfg.world_size
+            if cfg.disable_enhancements
+            else float(plan.shares[rank])
+        )
+        tok_counts = m.reshape(plan.num_steps, -1).sum(axis=1)
+        weights = m * (
+            p_r / np.maximum(tok_counts, 1.0)[:, None, None]
+        ).astype(np.float32)
+        return x, y, weights
+
+    # ------------------------------------------------------------- validate
+
+    def validate(self, batch: int = 0) -> Tuple[float, float]:
+        cfg = self.cfg
+        eval_bsz = 10  # dataloader.py:109
+        stream = self.corpus.test
+        if cfg.debug and len(stream) > 20_000:
+            stream = stream[:20_000]
+        data = batchify(stream, eval_bsz)
+        x, y, m = bptt_windows(data, cfg.bptt)
+        views = shard_views(self.state.params, self.topology.devices)
+        dev = self.topology.devices[0]
+        loss_sum = count = 0.0
+        import jax
+
+        for s in range(x.shape[0]):
+            ls, _, ct = self.steps.eval_step(
+                views[0],
+                jax.device_put(x[s], dev),
+                jax.device_put(y[s], dev),
+                jax.device_put(m[s], dev),
+            )
+            loss_sum += float(ls)
+            count += float(ct)
+        val_loss = loss_sum / max(count, 1.0)
+        # "accuracy" = 1 - val_loss: the reference's LM convention
+        # (dbs.py:180-181), not a real accuracy.
+        return val_loss, 1.0 - val_loss

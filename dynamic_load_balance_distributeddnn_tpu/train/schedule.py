@@ -1,0 +1,28 @@
+"""Learning-rate schedule: the reference's "one-cycle policy".
+
+In the reference, the warm-up phase is commented out (dbs.py:206-208) and only
+the final-30% decay branch is live; that branch contains an evident typo
+(``epoch - 0.7 * epoch`` for ``epoch - 0.7 * epoch_size``, dbs.py:210) that
+makes the decay discontinuous. This implementation follows the *documented*
+behavior (dbs.py:195-199): constant base LR, then a linear decay over the last
+30% of epochs down to 0.01x — i.e. the live branch with the typo fixed.
+Disabled entirely under `-de` (dbs.py:202-203).
+"""
+
+from __future__ import annotations
+
+
+def one_cycle_lr(
+    base_lr: float,
+    epoch: int,
+    epoch_size: int,
+    enabled: bool = True,
+    disable_enhancements: bool = False,
+) -> float:
+    if not enabled or disable_enhancements:
+        return base_lr
+    start = 0.7 * epoch_size
+    if epoch >= start:
+        frac = (epoch - start) / (0.3 * epoch_size)
+        return base_lr - 0.99 * base_lr * frac
+    return base_lr

@@ -1,0 +1,67 @@
+"""Training state: params + SGD(momentum) optimizer state.
+
+The reference's optimizer is ``optim.SGD(lr, momentum=0.9)`` with a
+per-epoch learning-rate override for the one-cycle policy (dbs.py:369,
+193-215). Here optax's sgd is wrapped in ``inject_hyperparams`` so the learning
+rate lives *in the optimizer state* and can be set per epoch without
+recompiling the update step.
+
+State is replicated over the data mesh: every device holds the full params
+and momentum, as every reference worker does (dbs.py:365-369). (Sharding the
+optimizer state ZeRO-style is an available upgrade; the mesh machinery does
+not foreclose it.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray  # global step counter
+
+    def learning_rate(self) -> float:
+        return float(self.opt_state.hyperparams["learning_rate"])
+
+    def with_learning_rate(self, lr: float) -> "TrainState":
+        hp = dict(self.opt_state.hyperparams)
+        hp["learning_rate"] = jnp.asarray(lr, dtype=jnp.float32)
+        return self.replace(opt_state=self.opt_state._replace(hyperparams=hp))
+
+
+def make_optimizer(learning_rate: float, momentum: float = 0.9) -> optax.GradientTransformation:
+    return optax.inject_hyperparams(optax.sgd)(
+        learning_rate=learning_rate, momentum=momentum
+    )
+
+
+def create_state(
+    module,
+    example_input: jnp.ndarray,
+    tx: optax.GradientTransformation,
+    seed: int = 1234,
+    sharding: Optional[jax.sharding.Sharding] = None,
+) -> TrainState:
+    """Initialize params deterministically from ``seed`` (the analogue of the
+    reference's torch.manual_seed(1234) + initial cross-worker param averaging
+    dbs.py:329/365-367 — replication by construction instead of by allreduce)."""
+
+    def init_fn(key):
+        params = module.init({"params": key, "dropout": key}, example_input, train=False)
+        opt_state = tx.init(params)
+        return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+    key = jax.random.PRNGKey(seed)
+    if sharding is not None:
+        state = jax.jit(init_fn, out_shardings=sharding)(key)
+    else:
+        state = jax.jit(init_fn)(key)
+    return state

@@ -1,0 +1,361 @@
+"""Compiled training steps.
+
+Two execution paths implement the reference's compute→combine→update loop
+(dbs.py:228-238):
+
+**Elastic path** — the DBS path. Each logical worker's forward/backward is its
+own XLA executable, compiled for that worker's *bucketed* batch shape and
+dispatched onto its device; workers sharing a device serialize there
+(contention, like the reference's `-gpu 0,0,0,1`), workers on different
+devices run concurrently (JAX async dispatch). Per-worker gradients are
+weighted per-example (ops/losses.py) so a plain SUM reproduces the
+reference's data-share-weighted combine (dbs.py:293-295); the sum + SGD
+update runs as ONE fused collective over the mesh — deliberately unlike the
+reference's per-parameter allreduce loop (dbs.py:294-300), which would be
+poison on ICI (SURVEY §5.8).
+
+**Fused path** — the uniform fast path (dbs off, or a converged uniform plan,
+one worker per chip): a single jitted SPMD step via shard_map — local grad,
+optional per-worker clip (reference clips before combining, dbs.py:274),
+psum, replicated update. No Python dispatch per worker, full XLA fusion.
+
+Both paths produce bitwise-identical update math for the same plan; they
+differ only in scheduling.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamic_load_balance_distributeddnn_tpu.models import ModelSpec
+from dynamic_load_balance_distributeddnn_tpu.ops.augment import augment_images, normalize_images
+from dynamic_load_balance_distributeddnn_tpu.ops.faultload import synthetic_load
+from dynamic_load_balance_distributeddnn_tpu.ops.losses import (
+    per_example_cross_entropy,
+    per_example_nll,
+)
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS
+from dynamic_load_balance_distributeddnn_tpu.train.state import TrainState
+
+
+def _per_example_loss(spec: ModelSpec, outputs: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    if spec.output_kind == "log_probs":
+        return per_example_nll(outputs, labels)
+    return per_example_cross_entropy(outputs, labels)
+
+
+class StepLibrary:
+    """Builds and caches every executable one model needs.
+
+    jax.jit's own cache handles the per-shape (bucketed batch) and per-device
+    specialization of the elastic path; this class just holds the closed-over
+    configuration.
+    """
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        mesh: Mesh,
+        tx: optax.GradientTransformation,
+        mean: Optional[np.ndarray] = None,
+        std: Optional[np.ndarray] = None,
+        augment: bool = False,
+        grad_clip: float = 0.0,
+        compute_dtype: Optional[Any] = None,
+    ):
+        self.spec = spec
+        self.mesh = mesh
+        self.tx = tx
+        self.mean = mean
+        self.std = std
+        self.augment = augment
+        self.grad_clip = grad_clip
+        # bfloat16 mixed precision: params/activations cast for the forward/
+        # backward, f32 master weights + f32 loss/grad accumulation
+        self.compute_dtype = compute_dtype
+        self._build()
+
+    def _cast_compute(self, tree):
+        if self.compute_dtype is None:
+            return tree
+        dt = self.compute_dtype
+        return jax.tree_util.tree_map(
+            lambda t: t.astype(dt) if hasattr(t, "dtype") and t.dtype == jnp.float32 else t,
+            tree,
+        )
+
+    # ------------------------------------------------------------ input prep
+
+    def _prep_images(self, x_u8: jnp.ndarray, rng: jax.Array, train: bool) -> jnp.ndarray:
+        if self.spec.input_kind == "tokens":
+            return x_u8
+        if self.mean is None:
+            return x_u8.astype(jnp.float32)
+        if train and self.augment:
+            return augment_images(x_u8, rng, self.mean, self.std)
+        return normalize_images(x_u8, self.mean, self.std)
+
+    # ----------------------------------------------------------- elastic path
+
+    def _build(self):
+        spec = self.spec
+        apply_fn = spec.module.apply
+
+        def local_grads(params, x, y, w, rng, slow_iters, train_prep_rng):
+            """Shared forward/backward for one worker's (padded) batch."""
+            x = self._cast_compute(self._prep_images(x, train_prep_rng, train=True))
+
+            def loss_fn(p):
+                out = apply_fn(self._cast_compute(p), x, train=True, rngs={"dropout": rng})
+                losses = _per_example_loss(spec, out.astype(jnp.float32), y)
+                mask = (w > 0).astype(jnp.float32)
+                wloss = jnp.sum(losses * w)
+                return wloss, (jnp.sum(losses * mask), jnp.sum(mask))
+
+            (wloss, (loss_sum, count)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+
+            if self.grad_clip > 0:
+                # The reference clips each worker's LOCAL mean gradient before
+                # the weighted combine (dbs.py:274). Our local grad is
+                # w_r * g_r, so unscale -> clip -> rescale.
+                w_r = jnp.maximum(jnp.sum(w), 1e-12)
+                unscaled = jax.tree_util.tree_map(lambda g: g / w_r, grads)
+                gnorm = optax.global_norm(unscaled)
+                scale = jnp.minimum(1.0, self.grad_clip / jnp.maximum(gnorm, 1e-12))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+            # Straggler injection (fault_mode='compute'): real, unelidable MXU
+            # work whose trip count is a traced scalar.
+            probe = synthetic_load(slow_iters, wloss)
+            return grads, wloss, loss_sum, count, probe
+
+        @jax.jit
+        def worker_step_first(params, x, y, w, rng, slow_iters):
+            g, wloss, loss_sum, count, probe = local_grads(
+                params, x, y, w, rng, slow_iters, rng
+            )
+            acc = jax.tree_util.tree_map(lambda t: t[None], g)
+            return acc, (wloss, loss_sum, count, probe)
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def worker_step_acc(params, acc, x, y, w, rng, slow_iters):
+            g, wloss, loss_sum, count, probe = local_grads(
+                params, x, y, w, rng, slow_iters, rng
+            )
+            acc = jax.tree_util.tree_map(lambda a, t: a + t[None], acc, g)
+            return acc, (wloss, loss_sum, count, probe)
+
+        self.worker_step_first = worker_step_first
+        self.worker_step_acc = worker_step_acc
+
+        # -------------------------------------------------- combine + update
+
+        replicated = NamedSharding(self.mesh, P())
+        tx = self.tx
+
+        @functools.partial(
+            jax.jit,
+            donate_argnums=(0, 1),
+            out_shardings=replicated,
+        )
+        def combine_update(state: TrainState, stacked_grads):
+            grads = jax.tree_util.tree_map(lambda g: jnp.sum(g, axis=0), stacked_grads)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return state.replace(params=params, opt_state=opt_state, step=state.step + 1)
+
+        self.combine_update = combine_update
+
+        # Non-donating twin used for timing probes: same collective + update
+        # math, but inputs stay valid and the result is discarded, so probing
+        # never double-applies an optimizer step.
+        @functools.partial(jax.jit, out_shardings=replicated)
+        def combine_probe(state: TrainState, stacked_grads):
+            grads = jax.tree_util.tree_map(lambda g: jnp.sum(g, axis=0), stacked_grads)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return state.replace(params=params, opt_state=opt_state, step=state.step + 1)
+
+        self.combine_probe = combine_probe
+
+        # ------------------------------------------------------------- eval
+
+        @jax.jit
+        def eval_step(params, x, y, mask):
+            xf = self._prep_images(x, jax.random.PRNGKey(0), train=False)
+            out = apply_fn(params, xf, train=False)
+            losses = _per_example_loss(spec, out, y)
+            m = mask.astype(jnp.float32)
+            pred = jnp.argmax(out, axis=-1)
+            correct = jnp.sum((pred == y).astype(jnp.float32) * m)
+            return jnp.sum(losses * m), correct, jnp.sum(m)
+
+        self.eval_step = eval_step
+
+    # ------------------------------------------------------------ fused path
+
+    def _fused_shard_body(self, state, x, y, w, slow_scalar, seed):
+        """Per-device body of the fused SPMD step: local grad, optional
+        per-worker clip (reference clips before combining, dbs.py:274), psum,
+        replicated SGD update."""
+        spec = self.spec
+        apply_fn = spec.module.apply
+        tx = self.tx
+        idx = jax.lax.axis_index(DATA_AXIS)
+        rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(0), seed), idx),
+            state.step,
+        )
+        x = self._cast_compute(self._prep_images(x, rng, train=True))
+
+        def loss_fn(p):
+            out = apply_fn(self._cast_compute(p), x, train=True, rngs={"dropout": rng})
+            losses = _per_example_loss(spec, out.astype(jnp.float32), y)
+            mask = (w > 0).astype(jnp.float32)
+            return jnp.sum(losses * w), (jnp.sum(losses * mask), jnp.sum(mask))
+
+        (wloss, (loss_sum, count)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        if self.grad_clip > 0:
+            w_r = jnp.maximum(jnp.sum(w), 1e-12)
+            unscaled = jax.tree_util.tree_map(lambda g: g / w_r, grads)
+            gnorm = optax.global_norm(unscaled)
+            scale = jnp.minimum(1.0, self.grad_clip / jnp.maximum(gnorm, 1e-12))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+        probe = synthetic_load(slow_scalar, wloss)
+        grads = jax.lax.psum(grads, DATA_AXIS)
+        metrics = jax.lax.psum(jnp.stack([wloss, loss_sum, count, probe]), DATA_AXIS)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
+        return state, metrics
+
+    @functools.cached_property
+    def fused_step(self):
+        """One-jit SPMD step for uniform plans with one worker per device.
+        Inputs: state (replicated), batch [D*b, ...] (sharded on 'data'),
+        per-example weights, per-device slow_iters [D], scalar seed."""
+
+        def per_shard(state, x, y, w, slow_iters, seed):
+            return self._fused_shard_body(state, x, y, w, slow_iters[0], seed)
+
+        sharded = jax.shard_map(
+            per_shard,
+            mesh=self.mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    @functools.cached_property
+    def fused_epoch(self):
+        """A whole epoch in ONE dispatch: lax.scan over the step axis inside
+        the SPMD program. Inputs are the full epoch's batches
+        [steps, D*b, ...] (sharded on the batch axis); state is carried by the
+        scan. The dbs-off / converged-uniform fast path — no per-step Python,
+        full XLA pipelining."""
+
+        def per_shard(state, xs, ys, ws_, slow_iters, seed):
+            def body(state, inp):
+                x, y, w = inp
+                return self._fused_shard_body(state, x, y, w, slow_iters[0], seed)
+
+            state, metrics = jax.lax.scan(body, state, (xs, ys, ws_))
+            return state, jnp.sum(metrics, axis=0)
+
+        sharded = jax.shard_map(
+            per_shard,
+            mesh=self.mesh,
+            in_specs=(
+                P(),
+                P(None, DATA_AXIS),
+                P(None, DATA_AXIS),
+                P(None, DATA_AXIS),
+                P(DATA_AXIS),
+                P(),
+            ),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    @functools.cached_property
+    def fused_eval_step(self):
+        """Sharded evaluation over the mesh — the whole test batch split across
+        devices. (The reference redundantly evaluates the FULL test set on
+        every rank, dbs.py:147; sharding it is the same math, ws× faster.)"""
+        spec = self.spec
+        apply_fn = spec.module.apply
+        prep = self._prep_images
+
+        def per_shard(params, x, y, mask):
+            xf = prep(x, jax.random.PRNGKey(0), train=False)
+            out = apply_fn(params, xf, train=False)
+            losses = _per_example_loss(spec, out, y)
+            m = mask.astype(jnp.float32)
+            pred = jnp.argmax(out, axis=-1)
+            stats = jnp.stack(
+                [jnp.sum(losses * m), jnp.sum((pred == y).astype(jnp.float32) * m), jnp.sum(m)]
+            )
+            return jax.lax.psum(stats, DATA_AXIS)
+
+        sharded = jax.shard_map(
+            per_shard,
+            mesh=self.mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+
+def stack_partials(partials_by_device, mesh: Mesh):
+    """Zero-copy assembly of per-device gradient partials (each with a leading
+    [1, ...] axis, living on its device) into global arrays sharded over the
+    mesh — the input of combine_update. This is the moment the reference would
+    enter its gloo allreduce (dbs.py:296); here it is just array surgery, the
+    actual reduction happens inside the combine_update collective."""
+    n = len(partials_by_device)
+    assert n == len(mesh.devices.flat)
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    leaves_by_dev = [jax.tree_util.tree_leaves(p) for p in partials_by_device]
+    treedef = jax.tree_util.tree_structure(partials_by_device[0])
+    stacked_leaves = []
+    for li in range(len(leaves_by_dev[0])):
+        shards = [leaves_by_dev[d][li] for d in range(n)]
+        shape = (n,) + tuple(shards[0].shape[1:])
+        stacked_leaves.append(
+            jax.make_array_from_single_device_arrays(shape, sharding, shards)
+        )
+    return jax.tree_util.tree_unflatten(treedef, stacked_leaves)
+
+
+def shard_views(tree, devices):
+    """Per-device single-device views of a replicated global tree: one tree
+    per requested device whose leaves are that device's local shards (no
+    copies)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    views = [[] for _ in devices]
+    index = {dev: i for i, dev in enumerate(devices)}
+    for leaf in leaves:
+        hit = 0
+        for s in leaf.addressable_shards:
+            i = index.get(s.device)
+            if i is not None:
+                views[i].append(s.data)
+                hit += 1
+        assert hit == len(devices), "replicated tree missing shards for mesh devices"
+    return [jax.tree_util.tree_unflatten(treedef, v) for v in views]

@@ -1,0 +1,60 @@
+import numpy as np
+
+from dynamic_load_balance_distributeddnn_tpu.data.corpus import (
+    Corpus,
+    batchify,
+    bptt_windows,
+)
+
+
+def test_corpus_from_files(tmp_path):
+    (tmp_path / "train.txt").write_text("a b c\nd e\n")
+    (tmp_path / "valid.txt").write_text("a b\n")
+    (tmp_path / "test.txt").write_text("c d\n")
+    c = Corpus(str(tmp_path))
+    # vocab: a b c <eos> d e == 6
+    assert c.ntokens == 6
+    assert len(c.train) == 7  # a b c <eos> d e <eos>
+    assert not c.synthetic
+
+
+def test_corpus_missing_train_uses_valid(tmp_path):
+    (tmp_path / "valid.txt").write_text("x y z\n")
+    (tmp_path / "test.txt").write_text("x y\n")
+    c = Corpus(str(tmp_path))
+    assert np.array_equal(c.train, c.valid)
+
+
+def test_corpus_synthetic_fallback(tmp_path):
+    c = Corpus(str(tmp_path / "nope"))
+    assert c.synthetic
+    assert c.ntokens == 2000
+    assert len(c.train) == 200_000
+
+
+def test_batchify_shape_and_trim():
+    stream = np.arange(103, dtype=np.int32)
+    data = batchify(stream, 10)
+    assert data.shape == (10, 10)  # 3 trailing tokens trimmed
+    # column-major fold: column j holds a contiguous chunk
+    assert data[0, 0] == 0 and data[1, 0] == 1
+    assert data[0, 1] == 10
+
+
+def test_bptt_windows_targets_shift_by_one():
+    stream = np.arange(200, dtype=np.int32)
+    data = batchify(stream, 4)  # [50, 4]
+    x, y, m = bptt_windows(data, bptt=35)
+    assert x.shape == (2, 4, 35)  # windows at 0 and 35
+    assert np.all(y[0, :, :][m[0].astype(bool)].reshape(4, -1)[:, 0] == data[1])
+    # final window is short: seq = 50-1-35 = 14
+    assert m[1].sum() == 4 * 14
+    # x/y shift invariant wherever mask is on
+    assert np.array_equal(x[0, 0, 1:], y[0, 0, :-1])
+
+
+def test_bptt_windows_pad_columns():
+    data = batchify(np.arange(80, dtype=np.int32), 4)
+    x, y, m = bptt_windows(data, bptt=10, pad_bsz=8)
+    assert x.shape[1] == 8
+    assert m[:, 4:, :].sum() == 0

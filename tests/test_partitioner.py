@@ -1,0 +1,92 @@
+"""Properties of the dynamic data partitioner (reference: dataloader.py:12-49)."""
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.balance import initial_partition, rebalance
+from dynamic_load_balance_distributeddnn_tpu.data import (
+    build_epoch_plan,
+    partition_indices,
+)
+
+
+def test_partitions_disjoint_and_sized():
+    n = 10007
+    shares = np.array([0.4, 0.3, 0.2, 0.1])
+    parts = partition_indices(n, shares, seed=1234)
+    seen = np.concatenate(parts)
+    assert len(np.unique(seen)) == len(seen)  # disjoint
+    for p, s in zip(parts, shares):
+        assert len(p) == int(s * n)  # reference's int() truncation
+
+
+def test_partition_deterministic_across_calls():
+    a = partition_indices(1000, [0.5, 0.5], seed=7)
+    b = partition_indices(1000, [0.5, 0.5], seed=7)
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+    c = partition_indices(1000, [0.5, 0.5], seed=8)
+    assert not np.array_equal(a[0], c[0])
+
+
+def test_equal_step_invariant():
+    """All workers run ~ the same number of steps despite unequal batch
+    sizes — the invariant that keeps synchronous collectives aligned
+    (SURVEY §3.3)."""
+    n, B = 50000, 512
+    shares, batches = rebalance(
+        np.array([3.0, 1.0, 1.0, 1.0]) * 0.25, initial_partition(4), B
+    )
+    plan = build_epoch_plan(n, shares, batches, B, epoch=0, seed=1234)
+    steps = [w.steps for w in plan.workers]
+    assert max(steps) - min(steps) <= 1
+    assert plan.num_steps == max(steps)
+
+
+def test_plan_masks_cover_exactly_owned_examples():
+    n, B = 5000, 64
+    shares, batches = rebalance(
+        np.array([1.0, 2.0, 1.0, 1.0]), initial_partition(4), B
+    )
+    plan = build_epoch_plan(n, shares, batches, B, epoch=3, seed=1234, bucket=16)
+    for w in plan.workers:
+        idx, mask = plan.epoch_indices(w.rank)
+        assert idx.shape == (plan.num_steps, w.padded_batch)
+        assert mask.sum() == len(w.indices)  # every owned example exactly once
+        assert set(idx[mask].tolist()) == set(w.indices.tolist())
+        assert w.padded_batch % 16 == 0
+        assert w.padded_batch - w.batch_size < 16
+
+
+def test_uniform_plan_detection():
+    plan = build_epoch_plan(
+        4096, np.full(4, 0.25), np.full(4, 128, dtype=np.int64), 512, epoch=0
+    )
+    assert plan.is_uniform()
+    plan2 = build_epoch_plan(
+        4096,
+        np.array([0.3, 0.3, 0.2, 0.2]),
+        np.array([154, 154, 102, 102]),
+        512,
+        epoch=0,
+    )
+    assert not plan2.is_uniform()
+
+
+def test_reshuffle_changes_batch_order_not_ownership():
+    n, B = 2000, 100
+    shares = np.array([0.5, 0.5])
+    batches = np.array([50, 50])
+    p0 = build_epoch_plan(n, shares, batches, B, epoch=0)
+    p1 = build_epoch_plan(n, shares, batches, B, epoch=1)
+    for r in range(2):
+        assert set(p0.workers[r].indices.tolist()) == set(
+            p1.workers[r].indices.tolist()
+        )
+    assert not np.array_equal(p0.workers[0].indices, p1.workers[0].indices)
+
+
+def test_lm_no_shuffle_contiguous():
+    parts = partition_indices(100, [0.5, 0.5], shuffle=False)
+    assert np.array_equal(parts[0], np.arange(50))
+    assert np.array_equal(parts[1], np.arange(50, 100))

@@ -1,0 +1,89 @@
+"""Properties of the DBS partition solver (reference: dbs.py:458-476)."""
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.balance import (
+    initial_partition,
+    integer_batch_split,
+    rebalance,
+)
+
+
+def test_initial_partition_uniform():
+    p = initial_partition(4)
+    assert np.allclose(p, 0.25)
+    assert p.sum() == pytest.approx(1.0)
+
+
+def test_shares_sum_to_one_and_batches_bounded():
+    rng = np.random.RandomState(0)
+    for _ in range(200):
+        ws = rng.randint(2, 9)
+        b = rng.randint(ws, 1024)
+        times = rng.uniform(0.1, 10.0, ws)
+        shares = rng.dirichlet(np.ones(ws))
+        new_shares, batches = rebalance(times, shares, b)
+        assert new_shares.sum() == pytest.approx(1.0)
+        # the 0.5-remainder cutoff may drop a few units but never exceed B
+        assert batches.sum() <= b
+        assert batches.sum() >= b - ws
+        assert (batches >= 0).all()
+
+
+def test_inverse_time_monotonicity():
+    """Slower workers get smaller shares: with equal current shares, the
+    ordering of new shares is the reverse of the ordering of times."""
+    times = np.array([1.0, 2.0, 3.0, 4.0])
+    shares, _ = rebalance(times, initial_partition(4), 512)
+    assert (np.diff(shares) < 0).all()
+
+
+def test_one_step_fixed_point():
+    """Epoch time t_i = c_i * p_i implies the update lands on the balanced
+    fixed point in a single step: p ∝ 1/c."""
+    cost = np.array([3.0, 1.0, 1.0, 1.0])  # the 3:1 straggler profile
+    p0 = initial_partition(4)
+    times = cost * p0
+    shares, _ = rebalance(times, p0, 512)
+    expect = (1 / cost) / (1 / cost).sum()
+    assert np.allclose(shares, expect, atol=2 / 512)
+    # and the fixed point is stable: re-running with balanced times keeps it
+    times2 = cost * shares  # all equal now
+    shares2, _ = rebalance(times2, shares, 512)
+    assert np.allclose(shares2, shares, atol=2 / 512)
+
+
+def test_equal_times_preserve_shares():
+    p = np.array([0.4, 0.3, 0.2, 0.1])
+    shares, batches = rebalance(np.ones(4), p, 1000)
+    assert np.allclose(shares, p, atol=2 / 1000)
+    assert batches.sum() <= 1000
+
+
+def test_integer_split_exact_when_remainders_large():
+    # shares 0.25*4 on B=512 divides exactly
+    batches = integer_batch_split(np.full(4, 0.25), 512)
+    assert (batches == 128).all()
+
+
+def test_integer_split_half_cutoff():
+    # remainders below 0.5 are never rounded up (dbs.py:470-473)
+    batches = integer_batch_split(np.array([0.3, 0.3, 0.4]), 11)
+    # ideal = [3.3, 3.3, 4.4]; floors [3,3,4]; short=1, top remainder 0.4 < 0.5
+    assert batches.tolist() == [3, 3, 4]
+    assert batches.sum() == 10  # one unit deliberately dropped
+
+
+def test_max_share_clamp():
+    times = np.array([100.0, 1.0, 1.0, 1.0])  # extreme straggler
+    shares, _ = rebalance(times, initial_partition(4), 512, max_share=0.4)
+    assert shares.max() <= 0.4 + 2 / 512
+    assert shares.sum() == pytest.approx(1.0)
+
+
+def test_rejects_bad_input():
+    with pytest.raises(ValueError):
+        rebalance(np.array([1.0, -1.0]), np.array([0.5, 0.5]), 64)
+    with pytest.raises(ValueError):
+        rebalance(np.array([1.0]), np.array([0.5, 0.5]), 64)

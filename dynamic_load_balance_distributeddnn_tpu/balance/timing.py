@@ -16,15 +16,15 @@ collective on 8 scalars).
 
 from __future__ import annotations
 
-import time
-from typing import Dict, List
-
 import numpy as np
 
 
 class TimeKeeper:
-    """Accumulates per-worker compute seconds and global comm seconds for one
-    epoch. Not thread-safe; the engine drives it from the controller thread."""
+    """Accumulates per-worker compute and injected-straggler seconds for one
+    epoch; the engine combines them (with any fault time multipliers) into the
+    solver's node-time vector. Comm time is deliberately absent: the balancer
+    reacts to compute speed only (reference contract, dbs.py:250/425).
+    Not thread-safe; the engine drives it from the controller thread."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
@@ -32,25 +32,16 @@ class TimeKeeper:
 
     def reset(self) -> None:
         self.compute_s = np.zeros(self.world_size, dtype=np.float64)
-        self.comm_s = 0.0
         self.injected_s = np.zeros(self.world_size, dtype=np.float64)
 
     def add_compute(self, worker: int, seconds: float) -> None:
         self.compute_s[worker] += seconds
-
-    def add_comm(self, seconds: float) -> None:
-        self.comm_s += seconds
 
     def add_injected(self, worker: int, seconds: float) -> None:
         """Virtual straggler seconds (fault_mode='virtual'): counted into the
         time vector the solver sees, mirroring the reference's sleeps being
         measured into train_time (dbs.py:103, 241)."""
         self.injected_s[worker] += seconds
-
-    def node_times(self) -> np.ndarray:
-        """The per-worker times fed to the solver: compute + injected, never
-        comm (reference contract, dbs.py:250/425)."""
-        return self.compute_s + self.injected_s
 
 
 def exchange_times(local_times: np.ndarray) -> np.ndarray:
@@ -68,19 +59,3 @@ def exchange_times(local_times: np.ndarray) -> np.ndarray:
         np.asarray(local_times, dtype=np.float64)
     )
     return np.asarray(gathered).reshape(-1)
-
-
-class StepClock:
-    """Context helper for wall-clock sections with monotonic time."""
-
-    def __init__(self):
-        self._t0 = None
-        self.elapsed = 0.0
-
-    def __enter__(self):
-        self._t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        self.elapsed = time.perf_counter() - self._t0
-        return False

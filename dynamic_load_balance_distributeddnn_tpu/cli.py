@@ -1,8 +1,9 @@
 """Command-line entry point.
 
 The analogue of ``python dbs.py <flags>`` (dbs.py:527-544): parse the 13
-reference flags (+ TPU extras), skip runs whose rank-0 log already exists
-(idempotence probe, dbs.py:528-534), then run the training engine. No process
+reference flags (+ TPU extras), skip runs whose completion sentinel already
+exists (idempotence probe, hardened from the reference's log-file check,
+dbs.py:528-534), then run the training engine. No process
 forking — the SPMD controller drives all logical workers from one process per
 host (SURVEY §7.1).
 """
@@ -13,7 +14,10 @@ import sys
 from typing import Optional, Sequence
 
 from dynamic_load_balance_distributeddnn_tpu.config import config_from_args
-from dynamic_load_balance_distributeddnn_tpu.obs.logging import run_already_done
+from dynamic_load_balance_distributeddnn_tpu.obs.logging import (
+    mark_run_done,
+    run_already_done,
+)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -33,6 +37,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         trainer = Trainer(cfg)
     trainer.run()
+    mark_run_done(cfg)
     return 0
 
 

@@ -1,0 +1,41 @@
+"""Data plane: dataset readers, the dynamic partitioner, and the LM corpus.
+
+Mirrors the reference's data layer (dataloader.py, prepare_data.py) with the
+TPU-first twist that batches are *bucketed/padded to static shapes* and carry
+per-example masks, so XLA compiles a bounded number of executables while the
+true per-worker load still follows the balancer's plan (SURVEY §7.3).
+"""
+
+from dynamic_load_balance_distributeddnn_tpu.data.corpus import (
+    Corpus,
+    Dictionary,
+    batchify,
+    bptt_windows,
+)
+from dynamic_load_balance_distributeddnn_tpu.data.datasets import (
+    NORM_STATS,
+    DatasetBundle,
+    load_dataset,
+    synthetic_dataset,
+)
+from dynamic_load_balance_distributeddnn_tpu.data.partitioner import (
+    EpochPlan,
+    WorkerPlan,
+    build_epoch_plan,
+    partition_indices,
+)
+
+__all__ = [
+    "Corpus",
+    "Dictionary",
+    "batchify",
+    "bptt_windows",
+    "NORM_STATS",
+    "DatasetBundle",
+    "load_dataset",
+    "synthetic_dataset",
+    "EpochPlan",
+    "WorkerPlan",
+    "build_epoch_plan",
+    "partition_indices",
+]

@@ -1,0 +1,145 @@
+"""The dynamic data partitioner (reference: dataloader.py:12-49).
+
+Ownership semantics match the reference exactly: a fixed-seed permutation of
+the example indices is sliced into contiguous fractions of length
+``int(share_r * n)`` per worker (dataloader.py:37-46) — deterministic and
+replicated, so every host derives the identical plan with no coordinator.
+
+On top of ownership, each epoch gets an :class:`EpochPlan`: per-worker batch
+sizes from the balancer, a per-epoch reshuffle *within* each worker's shard,
+and TPU-specific static-shape planning — batch sizes are padded up to a
+``bucket`` multiple so XLA compiles at most ``B/bucket`` distinct executables
+per model, with masks marking the real examples (SURVEY §7.3 strategy (b)).
+
+The equal-step invariant (shard fraction == batch fraction ⇒ all workers run
+~the same number of steps, dataloader.py:42-46, SURVEY §3.3) is preserved:
+``num_steps`` is the max over workers, and workers with fewer steps get fully
+masked padding steps so synchronous combines stay aligned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def partition_indices(
+    n: int,
+    shares: Sequence[float],
+    seed: int = 1234,
+    shuffle: bool = True,
+) -> List[np.ndarray]:
+    """Slice ``n`` example indices into per-worker shards of length
+    ``int(share_r * n)`` (the reference's truncation, dataloader.py:42-46).
+
+    ``shuffle=True`` permutes indices first with a fixed seed (vision path,
+    dataloader.py:37-40); ``shuffle=False`` keeps the stream order (LM path —
+    the token stream must stay contiguous, dataloader.py:106)."""
+    shares = np.asarray(shares, dtype=np.float64)
+    if shuffle:
+        order = np.random.RandomState(seed).permutation(n)
+    else:
+        order = np.arange(n)
+    parts: List[np.ndarray] = []
+    lo = 0
+    for s in shares:
+        ln = int(s * n)
+        parts.append(order[lo : lo + ln].copy())
+        lo += ln
+    return parts
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerPlan:
+    """One worker's slice of an epoch."""
+
+    rank: int
+    indices: np.ndarray  # owned example indices, in this epoch's visit order
+    batch_size: int  # true per-step batch size (the balancer's decision)
+    padded_batch: int  # batch_size rounded up to the bucket multiple
+    steps: int  # number of real (non-padding) steps this worker runs
+
+
+@dataclasses.dataclass(frozen=True)
+class EpochPlan:
+    """The full, replicated plan for one epoch: who owns what, at which batch
+    size, for how many steps."""
+
+    epoch: int
+    shares: np.ndarray
+    batch_sizes: np.ndarray
+    workers: Tuple[WorkerPlan, ...]
+    num_steps: int
+    global_batch: int
+
+    def is_uniform(self) -> bool:
+        """True when every worker has identical batch/padded/step geometry —
+        the precondition for the fused single-executable SPMD path."""
+        bs = {w.batch_size for w in self.workers}
+        pd = {w.padded_batch for w in self.workers}
+        st = {w.steps for w in self.workers}
+        return len(bs) == 1 and len(pd) == 1 and len(st) == 1
+
+    def epoch_indices(self, rank: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Materialize worker ``rank``'s epoch as static-shape step batches.
+
+        Returns ``(idx, mask)`` of shape ``[num_steps, padded_batch]``: row s
+        holds the example indices of step s (zeros in padding slots) and the
+        mask marks real examples. Every owned index appears exactly once."""
+        w = self.workers[rank]
+        idx = np.zeros((self.num_steps, w.padded_batch), dtype=np.int64)
+        mask = np.zeros((self.num_steps, w.padded_batch), dtype=bool)
+        b = max(w.batch_size, 1)
+        for s in range(w.steps):
+            chunk = w.indices[s * b : (s + 1) * b]
+            idx[s, : len(chunk)] = chunk
+            mask[s, : len(chunk)] = True
+        return idx, mask
+
+
+def build_epoch_plan(
+    n: int,
+    shares: Sequence[float],
+    batch_sizes: Sequence[int],
+    global_batch: int,
+    epoch: int,
+    seed: int = 1234,
+    bucket: int = 16,
+) -> EpochPlan:
+    """Plan one epoch: fixed-seed ownership (identical across epochs, like the
+    reference's fixed partitioner seed 1234, dbs.py:313), a per-epoch shuffle
+    of each worker's visit order, bucketed static batch shapes, and step
+    counts satisfying the equal-step invariant."""
+    shares = np.asarray(shares, dtype=np.float64)
+    batch_sizes = np.asarray(batch_sizes, dtype=np.int64)
+    parts = partition_indices(n, shares, seed=seed, shuffle=True)
+    workers: List[WorkerPlan] = []
+    num_steps = 0
+    for rank, (owned, b) in enumerate(zip(parts, batch_sizes)):
+        b = int(max(b, 1))
+        order = np.random.RandomState(seed * 1000003 + epoch * 9176 + rank).permutation(
+            len(owned)
+        )
+        visit = owned[order]
+        steps = max(-(-len(visit) // b), 1)
+        padded = -(-b // bucket) * bucket
+        workers.append(
+            WorkerPlan(
+                rank=rank,
+                indices=visit,
+                batch_size=b,
+                padded_batch=padded,
+                steps=steps,
+            )
+        )
+        num_steps = max(num_steps, steps)
+    return EpochPlan(
+        epoch=epoch,
+        shares=shares.copy(),
+        batch_sizes=batch_sizes,
+        workers=tuple(workers),
+        num_steps=num_steps,
+        global_batch=int(global_batch),
+    )

@@ -69,9 +69,13 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         # tokens: [B, T] int32 -> log-probs [B, T, ntoken]
         b, t = tokens.shape
-        x = nn.Embed(self.ntoken, self.ninp, embedding_init=nn.initializers.uniform(0.2))(
-            tokens
-        )
+        # symmetric U[-0.1, 0.1] like the reference (Net/Transformer.py:77-78);
+        # flax's initializers.uniform(s) is U[0, s) and would bias every
+        # embedding positive
+        def embed_init(key, shape, dtype=jnp.float32):
+            return jax.random.uniform(key, shape, dtype, -0.1, 0.1)
+
+        x = nn.Embed(self.ntoken, self.ninp, embedding_init=embed_init)(tokens)
         x = x * jnp.sqrt(float(self.ninp))
         # trace-time constant; folded by XLA, never a trainable parameter
         pe = jnp.asarray(sinusoidal_positions(min(self.max_len, max(t, 1)), self.ninp))

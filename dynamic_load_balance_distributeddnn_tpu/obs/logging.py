@@ -50,9 +50,21 @@ def init_logger(cfg: Config, rank: int = 0, to_file: bool = True) -> logging.Log
     return logging.LoggerAdapter(logger, extra)
 
 
+def _done_sentinel(cfg: Config) -> str:
+    return os.path.join(cfg.log_dir, cfg.base_filename().format(0) + ".done")
+
+
+def mark_run_done(cfg: Config) -> None:
+    """Record successful completion. The reference probes the rank-0 *log*
+    (dbs.py:528-534), but the log is created at startup, so a crashed run
+    would be skipped forever; a separate sentinel written only after the
+    metrics are saved fixes that while keeping run-level idempotence."""
+    os.makedirs(cfg.log_dir, exist_ok=True)
+    with open(_done_sentinel(cfg), "w") as f:
+        f.write("done\n")
+
+
 def run_already_done(cfg: Config) -> bool:
-    """Idempotence probe: a completed run leaves its rank-0 log behind
-    (reference behavior, dbs.py:528-534)."""
-    return os.path.isfile(
-        os.path.join(cfg.log_dir, cfg.base_filename().format(0) + ".log")
-    )
+    """Idempotence probe for completed runs (reference behavior,
+    dbs.py:528-534, hardened via the post-completion sentinel)."""
+    return os.path.isfile(_done_sentinel(cfg))

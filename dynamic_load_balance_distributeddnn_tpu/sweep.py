@@ -2,7 +2,7 @@
 
 Runs the reference grid {dbs on/off} x {cifar10, cifar100} x
 {resnet, densenet, googlenet, regnet} with OCP enabled, aborting on the first
-failure, each leg idempotently skippable via its rank-0 log.
+failure, each leg idempotently skippable via its completion sentinel.
 """
 
 from __future__ import annotations
@@ -46,8 +46,15 @@ def main(argv=None) -> int:
             "-de", ns.disable_enhancements,
         ]
         print(f"==> sweep leg: model={model} dataset={dataset} dbs={dbs}")
-        rc = cli.main(args)
-        if rc != 0:  # fail fast, like run.sh:42-50
+        try:
+            rc = cli.main(args)
+        except Exception as e:  # fail fast, like run.sh:42-50
+            import traceback
+
+            traceback.print_exc()
+            print(f"sweep leg failed ({type(e).__name__}: {e}); aborting")
+            return 1
+        if rc != 0:
             print(f"sweep leg failed (rc={rc}); aborting")
             return rc
     return 0

@@ -447,7 +447,6 @@ class Trainer:
             modeled = np.asarray(self.timing_model(plan), dtype=np.float64)
             for r in range(cfg.world_size):
                 self.timekeeper.add_compute(r, modeled[r])
-        self.timekeeper.add_comm(sync_probe * plan.num_steps)
         for r in range(cfg.world_size):
             self.timekeeper.add_injected(r, float(faults.virtual_seconds[r]))
 

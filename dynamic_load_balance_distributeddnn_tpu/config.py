@@ -81,6 +81,8 @@ class Config:
     bptt: int = 35                     # LM window (dbs.py:343)
     grad_clip: float = 0.0             # LM path uses 0.25 (dbs.py:274)
     profile_dir: str = ""              # non-empty → jax.profiler traces
+    use_pallas: bool = False           # route GroupNorm/xent through the
+                                       # Pallas kernels (ops/pallas/)
 
     def __post_init__(self):
         if self.model not in MODELS:
@@ -167,6 +169,7 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--bptt", type=int, default=d.bptt)
     p.add_argument("--grad_clip", type=float, default=d.grad_clip)
     p.add_argument("--profile_dir", type=str, default=d.profile_dir)
+    p.add_argument("--use_pallas", type=str2bool, default=d.use_pallas)
     return p
 
 

@@ -56,5 +56,7 @@ def build_model(name: str, num_classes: int = 10, **kw) -> ModelSpec:
             TransformerLM,
         )
 
-        return ModelSpec(name, TransformerLM(**kw), "log_probs", "tokens")
+        # logits + softmax-xent == the reference's log_softmax + NLL
+        # (dbs.py:371-372) — same math, fused-kernel-friendly
+        return ModelSpec(name, TransformerLM(**kw), "logits", "tokens")
     raise ValueError(f"unknown model {name!r}")

@@ -7,7 +7,33 @@ import math
 import flax.linen as nn
 
 
-def group_norm(channels: int, groups: int = 32) -> nn.GroupNorm:
+class GroupNorm(nn.Module):
+    """GroupNorm routed through the fused Pallas kernel (ops/pallas/groupnorm).
+
+    Deliberately named ``GroupNorm`` so flax auto-naming produces the same
+    submodule names ("GroupNorm_N") — and therefore the same param pytree
+    ("scale"/"bias" of shape [C]) — as ``nn.GroupNorm``. The Pallas toggle is
+    thus compute-only: checkpoints and param trees are identical across it,
+    and flipping it between traces can never desynchronize parameters.
+
+    Same math as ``nn.GroupNorm``: stats in f32 with non-negative-clamped
+    variance, epsilon 1e-6.
+    """
+
+    num_groups: int
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        from dynamic_load_balance_distributeddnn_tpu.ops.pallas import fused_group_norm
+
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        return fused_group_norm(x, scale, bias, self.num_groups, self.epsilon)
+
+
+def group_norm(channels: int, groups: int = 32) -> nn.Module:
     """GroupNorm with the reference's group count where it divides the
     channel count, else the largest divisor of it that does.
 
@@ -15,5 +41,15 @@ def group_norm(channels: int, groups: int = 32) -> nn.GroupNorm:
     RegNetX-200MF config (widths starting at 24, Net/RegNet.py:108-117) would
     crash under that rule — the gcd fallback keeps every constructor usable
     while being identical wherever the reference actually runs.
+
+    When the Pallas toggle is on (ops.pallas.set_use_pallas, read at trace
+    time), the returned module runs the fused TPU kernel. Both branches have
+    identical names and parameters (see GroupNorm above), so the toggle
+    affects the compute path only.
     """
-    return nn.GroupNorm(num_groups=math.gcd(groups, channels))
+    from dynamic_load_balance_distributeddnn_tpu.ops import pallas as pk
+
+    g = math.gcd(groups, channels)
+    if pk.use_pallas():
+        return GroupNorm(num_groups=g)
+    return nn.GroupNorm(num_groups=g)

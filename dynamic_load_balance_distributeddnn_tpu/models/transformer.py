@@ -87,5 +87,8 @@ class TransformerLM(nn.Module):
             x = EncoderLayer(self.ninp, self.nhead, self.nhid, self.dropout)(
                 x, causal, train
             )
-        logits = nn.Dense(self.ntoken)(x)
-        return nn.log_softmax(logits, axis=-1)
+        # Raw logits; the loss layer applies softmax cross-entropy, which on
+        # logits equals the reference's NLLLoss-on-log_softmax composition
+        # (dbs.py:371-372) and lets the fused Pallas xent kernel take the
+        # vocab-sized reduction.
+        return nn.Dense(self.ntoken)(x)

@@ -1,0 +1,126 @@
+"""Fused softmax cross-entropy — per-example loss without materialized softmax.
+
+The reference's CNN criterion is ``nn.CrossEntropyLoss`` (dbs.py:374). The
+generic JAX spelling (logsumexp + gather) materializes intermediates over the
+full [rows, classes] block twice (forward exp, backward softmax). This kernel
+keeps a row-block of logits in VMEM and produces the per-example loss in one
+pass; the backward kernel recomputes softmax from the same logits block, so
+no softmax residual is ever written to HBM — the win grows with the class
+count (vocab-sized logits in the LM path).
+
+Label gather is expressed as an iota==label masked reduction (TPU has no
+cheap dynamic gather along lanes).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from dynamic_load_balance_distributeddnn_tpu.ops import pallas as _pk
+
+_ROW_BLOCK = 8
+
+
+def _xent_fwd_kernel(logits_ref, labels_ref, loss_ref):
+    x = logits_ref[...].astype(jnp.float32)      # [R, V]
+    lbl = labels_ref[...]                        # [R, 1] int32
+    m = jnp.max(x, axis=-1, keepdims=True)
+    logz = jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True)) + m
+    iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    gold = jnp.sum(jnp.where(iota == lbl, x, 0.0), axis=-1, keepdims=True)
+    loss_ref[...] = logz - gold
+
+
+def _xent_bwd_kernel(logits_ref, labels_ref, g_ref, dx_ref):
+    x = logits_ref[...].astype(jnp.float32)
+    lbl = labels_ref[...]
+    g = g_ref[...]                               # [R, 1]
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = (iota == lbl).astype(jnp.float32)
+    dx_ref[...] = (g * (p - onehot)).astype(dx_ref.dtype)
+
+
+def _pad_rows(a, rb):
+    r = a.shape[0]
+    pad = (-r) % rb
+    if pad:
+        a = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+    return a
+
+
+def _fwd_impl(logits, labels2, interpret):
+    r, v = logits.shape
+    grid = (r // _ROW_BLOCK,)
+    return pl.pallas_call(
+        _xent_fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_ROW_BLOCK, v), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((r, 1), jnp.float32),
+        interpret=interpret,
+    )(logits, labels2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fused_xent(logits, labels2, interpret):
+    return _fwd_impl(logits, labels2, interpret)
+
+
+def _fused_xent_fwd(logits, labels2, interpret):
+    return _fwd_impl(logits, labels2, interpret), (logits, labels2)
+
+
+def _fused_xent_bwd(interpret, res, dloss):
+    logits, labels2 = res
+    r, v = logits.shape
+    grid = (r // _ROW_BLOCK,)
+    dx = pl.pallas_call(
+        _xent_bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_ROW_BLOCK, v), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_ROW_BLOCK, v), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((r, v), logits.dtype),
+        interpret=interpret,
+    )(logits, labels2, dloss)
+    return dx, None
+
+
+_fused_xent.defvjp(_fused_xent_fwd, _fused_xent_bwd)
+
+
+def fused_softmax_xent(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Per-example softmax cross-entropy. logits: [..., C]; labels: [...] int.
+
+    Drop-in for ops.losses.per_example_cross_entropy (same contract,
+    dbs.py:374's criterion), differentiable w.r.t. logits.
+    """
+    if interpret is None:
+        interpret = _pk.interpret_default()
+    shape = labels.shape
+    v = logits.shape[-1]
+    flat = logits.reshape(-1, v)
+    lbl = labels.reshape(-1, 1).astype(jnp.int32)
+    r = flat.shape[0]
+    flat_p = _pad_rows(flat, _ROW_BLOCK)
+    lbl_p = _pad_rows(lbl, _ROW_BLOCK)
+    loss = _fused_xent(flat_p, lbl_p, interpret)[:r, 0]
+    return loss.reshape(shape)

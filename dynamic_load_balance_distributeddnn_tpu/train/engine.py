@@ -145,6 +145,9 @@ class Trainer:
 
     def _setup_model(self) -> None:
         cfg = self.cfg
+        from dynamic_load_balance_distributeddnn_tpu.ops.pallas import set_use_pallas
+
+        set_use_pallas(cfg.use_pallas)  # routes GroupNorm at module trace time
         self.spec = build_model(cfg.model, num_classes=self.bundle.num_classes)
         self.tx = make_optimizer(cfg.learning_rate, cfg.momentum)
         h, w, c = self.bundle.train_x.shape[1:]
@@ -166,6 +169,7 @@ class Trainer:
             augment=augment,
             grad_clip=cfg.grad_clip,
             compute_dtype=jnp.bfloat16 if cfg.precision == "bfloat16" else None,
+            use_pallas=cfg.use_pallas,
         )
 
     def _build_plan(self, epoch: int, batch_sizes: np.ndarray):

@@ -68,6 +68,9 @@ class LMTrainer(Trainer):
 
     def _setup_model(self) -> None:
         cfg = self.cfg
+        from dynamic_load_balance_distributeddnn_tpu.ops.pallas import set_use_pallas
+
+        set_use_pallas(cfg.use_pallas)
         self.spec = build_model(
             "transformer",
             ntoken=self.corpus.ntokens,
@@ -93,6 +96,7 @@ class LMTrainer(Trainer):
             self.tx,
             grad_clip=grad_clip,
             compute_dtype=jnp.bfloat16 if cfg.precision == "bfloat16" else None,
+            use_pallas=cfg.use_pallas,
         )
 
     # ------------------------------------------------------------- planning

@@ -45,9 +45,15 @@ from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS
 from dynamic_load_balance_distributeddnn_tpu.train.state import TrainState
 
 
-def _per_example_loss(spec: ModelSpec, outputs: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+def _per_example_loss(
+    spec: ModelSpec, outputs: jnp.ndarray, labels: jnp.ndarray, use_pallas: bool = False
+) -> jnp.ndarray:
     if spec.output_kind == "log_probs":
         return per_example_nll(outputs, labels)
+    if use_pallas:
+        from dynamic_load_balance_distributeddnn_tpu.ops.pallas import fused_softmax_xent
+
+        return fused_softmax_xent(outputs, labels)
     return per_example_cross_entropy(outputs, labels)
 
 
@@ -69,6 +75,7 @@ class StepLibrary:
         augment: bool = False,
         grad_clip: float = 0.0,
         compute_dtype: Optional[Any] = None,
+        use_pallas: bool = False,
     ):
         self.spec = spec
         self.mesh = mesh
@@ -77,6 +84,7 @@ class StepLibrary:
         self.std = std
         self.augment = augment
         self.grad_clip = grad_clip
+        self.use_pallas = use_pallas
         # bfloat16 mixed precision: params/activations cast for the forward/
         # backward, f32 master weights + f32 loss/grad accumulation
         self.compute_dtype = compute_dtype
@@ -114,7 +122,7 @@ class StepLibrary:
 
             def loss_fn(p):
                 out = apply_fn(self._cast_compute(p), x, train=True, rngs={"dropout": rng})
-                losses = _per_example_loss(spec, out.astype(jnp.float32), y)
+                losses = _per_example_loss(spec, out.astype(jnp.float32), y, self.use_pallas)
                 mask = (w > 0).astype(jnp.float32)
                 wloss = jnp.sum(losses * w)
                 return wloss, (jnp.sum(losses * mask), jnp.sum(mask))
@@ -219,7 +227,7 @@ class StepLibrary:
 
         def loss_fn(p):
             out = apply_fn(self._cast_compute(p), x, train=True, rngs={"dropout": rng})
-            losses = _per_example_loss(spec, out.astype(jnp.float32), y)
+            losses = _per_example_loss(spec, out.astype(jnp.float32), y, self.use_pallas)
             mask = (w > 0).astype(jnp.float32)
             return jnp.sum(losses * w), (jnp.sum(losses * mask), jnp.sum(mask))
 

@@ -2,6 +2,7 @@ from dynamic_load_balance_distributeddnn_tpu.balance.solver import (
     initial_partition,
     integer_batch_split,
     rebalance,
+    rebalance_py,
 )
 from dynamic_load_balance_distributeddnn_tpu.balance.timing import (
     TimeKeeper,
@@ -12,6 +13,7 @@ __all__ = [
     "initial_partition",
     "integer_batch_split",
     "rebalance",
+    "rebalance_py",
     "TimeKeeper",
     "exchange_times",
 ]

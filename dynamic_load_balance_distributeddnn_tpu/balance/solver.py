@@ -63,11 +63,38 @@ def rebalance(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """One DBS update: times + current shares -> (new shares, integer batches).
 
+    Dispatches to the C++ runtime solver when available (identical update +
+    rounding, native/src/dbs_native.cpp; parity enforced by
+    tests/test_native.py), else :func:`rebalance_py`.
+
     ``max_share`` is a TPU-native extension with no reference counterpart: it
     caps any worker's share (excess redistributed pro-rata) so the padded
     static-shape fast path has a bounded per-device capacity. Pass ``None``
     for exact reference behavior.
     """
+    t = np.asarray(node_times, dtype=np.float64)
+    p = np.asarray(shares, dtype=np.float64)
+    if t.shape != p.shape:
+        raise ValueError("node_times and shares must have the same length")
+    if np.any(t <= 0):
+        raise ValueError("node_times must be positive")
+
+    from dynamic_load_balance_distributeddnn_tpu.runtime import native_rebalance
+
+    nat = native_rebalance(t, p, global_batch, max_share)
+    if nat is not None:
+        return nat
+    return rebalance_py(t, p, global_batch, max_share)
+
+
+def rebalance_py(
+    node_times: np.ndarray,
+    shares: np.ndarray,
+    global_batch: int,
+    max_share: Optional[float] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pure-numpy reference implementation of one DBS update (the canonical
+    semantics; the native solver must match it bit-for-bit)."""
     t = np.asarray(node_times, dtype=np.float64)
     p = np.asarray(shares, dtype=np.float64)
     if t.shape != p.shape:

@@ -1,0 +1,15 @@
+"""Native host runtime: C++ gather/pack + solver behind a ctypes bridge."""
+
+from dynamic_load_balance_distributeddnn_tpu.runtime.native import (
+    native_available,
+    native_integer_batch_split,
+    native_rebalance,
+    take_rows,
+)
+
+__all__ = [
+    "native_available",
+    "native_integer_batch_split",
+    "native_rebalance",
+    "take_rows",
+]

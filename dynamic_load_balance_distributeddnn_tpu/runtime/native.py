@@ -1,0 +1,219 @@
+"""ctypes bridge to the C++ host runtime (native/src/dbs_native.cpp).
+
+The reference's host runtime is PyTorch's native machinery: the DataLoader
+worker pool packs per-step batches and gloo's C++ rings move bytes
+(reference dbs.py:511-515, dataloader.py:105-117). This framework's
+equivalents: the TPU compute/collective path is XLA; the *host* path —
+epoch materialization (gather/pack of every worker's step batches) and the
+replicated DBS solver — is first-party C++ here, loaded via ctypes (no
+pybind11 in this environment, SURVEY §2.2).
+
+Everything degrades gracefully: if the shared library is absent and cannot
+be built (no compiler), callers fall back to the numpy implementations with
+identical semantics. Parity is enforced by tests/test_native.py.
+
+Env knobs:
+  DBS_NATIVE=0        disable the native path entirely (forces numpy)
+  DBS_NATIVE_THREADS  gather thread count (default: hardware concurrency)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_NAME = "libdbs_native.so"
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build(src_dir: str) -> Optional[str]:
+    src = os.path.join(src_dir, "src", "dbs_native.cpp")
+    out = os.path.join(src_dir, _LIB_NAME)
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    try:
+        subprocess.run(
+            [
+                os.environ.get("CXX", "g++"),
+                "-O3",
+                "-std=c++17",
+                "-fPIC",
+                "-shared",
+                "-pthread",
+                "-o",
+                out,
+                src,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if os.environ.get("DBS_NATIVE", "1") == "0":
+            return None
+        path = _build(_REPO_NATIVE_DIR)
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        try:
+            lib.dbs_native_abi_version.restype = ctypes.c_int
+            if lib.dbs_native_abi_version() != _ABI_VERSION:
+                return None
+            lib.dbs_gather_rows.restype = ctypes.c_int
+            lib.dbs_gather_rows.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_int,
+            ]
+            lib.dbs_integer_batch_split.restype = ctypes.c_int
+            lib.dbs_integer_batch_split.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.dbs_rebalance.restype = ctypes.c_int
+            lib.dbs_rebalance.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int,
+                ctypes.c_int64,
+                ctypes.c_double,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+        except AttributeError:
+            return None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    """True when the C++ runtime is loaded (or loadable)."""
+    return _load() is not None
+
+
+# ------------------------------------------------------------------- gather
+
+
+def take_rows(data: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """``data[idx]`` along axis 0 via the multithreaded C++ gather.
+
+    ``data`` must be C-contiguous; ``idx`` may have any shape. The result has
+    shape ``idx.shape + data.shape[1:]`` — exactly ``np.take(data, idx, 0)``,
+    which is also the fallback when the native library is unavailable.
+    """
+    lib = _load()
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    if lib is None:
+        return np.take(data, idx, axis=0)
+    if not data.flags["C_CONTIGUOUS"]:
+        data = np.ascontiguousarray(data)
+    flat = idx.ravel()
+    row_bytes = int(data.dtype.itemsize * int(np.prod(data.shape[1:], dtype=np.int64)))
+    out = np.empty((flat.size,) + data.shape[1:], dtype=data.dtype)
+    rc = lib.dbs_gather_rows(
+        data.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(data.shape[0]),
+        ctypes.c_int64(row_bytes),
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(flat.size),
+        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(int(os.environ.get("DBS_NATIVE_THREADS", "0"))),
+    )
+    if rc != 0:
+        raise ValueError(f"dbs_gather_rows failed with code {rc}")
+    return out.reshape(idx.shape + data.shape[1:])
+
+
+# ------------------------------------------------------------------- solver
+
+
+def native_integer_batch_split(
+    shares: np.ndarray, global_batch: int
+) -> Optional[np.ndarray]:
+    """C++ integer split; ``None`` when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    s = np.ascontiguousarray(shares, dtype=np.float64)
+    out = np.zeros(s.size, dtype=np.int64)
+    rc = lib.dbs_integer_batch_split(
+        s.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int(s.size),
+        ctypes.c_int64(int(global_batch)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if rc != 0:
+        raise ValueError(f"dbs_integer_batch_split failed with code {rc}")
+    return out
+
+
+def native_rebalance(
+    node_times: np.ndarray,
+    shares: np.ndarray,
+    global_batch: int,
+    max_share: Optional[float] = None,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """C++ rebalance step; ``None`` when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    t = np.ascontiguousarray(node_times, dtype=np.float64)
+    p = np.ascontiguousarray(shares, dtype=np.float64)
+    if t.shape != p.shape:
+        raise ValueError("node_times and shares must have the same length")
+    out_s = np.zeros(t.size, dtype=np.float64)
+    out_b = np.zeros(t.size, dtype=np.int64)
+    rc = lib.dbs_rebalance(
+        t.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        p.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int(t.size),
+        ctypes.c_int64(int(global_batch)),
+        ctypes.c_double(-1.0 if max_share is None else float(max_share)),
+        out_s.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out_b.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if rc == -2:
+        raise ValueError("node_times must be positive")
+    if rc == -3:
+        raise ValueError("max_share too small to cover the batch")
+    if rc == -4:
+        raise ValueError("degenerate split: no worker received any batch")
+    if rc != 0:
+        raise ValueError(f"dbs_rebalance failed with code {rc}")
+    return out_s, out_b

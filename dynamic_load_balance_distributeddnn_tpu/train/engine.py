@@ -371,10 +371,14 @@ class Trainer:
 
     def _worker_inputs(self, plan, rank: int):
         """Materialize one worker's epoch: [steps, b_pad, ...] batches, labels
-        and per-example weights (the weighted-combine contract)."""
+        and per-example weights (the weighted-combine contract). The gather
+        runs through the native C++ runtime when available (multithreaded
+        row pack; runtime/native.py), numpy otherwise — identical results."""
+        from dynamic_load_balance_distributeddnn_tpu.runtime import take_rows
+
         idx, mask = plan.epoch_indices(rank)
-        x = self.bundle.train_x[idx]
-        y = self.bundle.train_y[idx]
+        x = take_rows(self.bundle.train_x, idx)
+        y = take_rows(self.bundle.train_y, idx)
         w = np.stack(
             [
                 example_weights(

@@ -8,64 +8,126 @@ real on-device compute (fault_mode='compute'), so epoch wall-clock genuinely
 moves; both arms run the same elastic execution path, so the comparison
 isolates the balancer.
 
+Each arm runs in its own subprocess with retries: a TPU runtime/tunnel crash
+(observed sporadically on this host) kills only that attempt, not the
+benchmark.
+
 Metric: steady-state epoch wall-clock with DBS on (seconds; lower is better).
 vs_baseline: speedup over the DBS-off arm (>1 means DBS wins).
 
 Environment knobs: BENCH_NTRAIN (default 12800), BENCH_EPOCHS (default 5),
-BENCH_WS (default 4).
+BENCH_WS (default 4), BENCH_RETRIES (default 3).
 """
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
+import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "./.jax_cache")
 
 
-def main() -> int:
-    import numpy as np
-
+def run_arm(dbs_on: bool, n_epochs: int, out_path: str) -> None:
+    """Subprocess entry: run one A/B arm and dump per-epoch walls to JSON."""
     from dynamic_load_balance_distributeddnn_tpu.config import Config
     from dynamic_load_balance_distributeddnn_tpu.data import load_dataset
     from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
     from dynamic_load_balance_distributeddnn_tpu.train import Trainer
 
     n_train = int(os.environ.get("BENCH_NTRAIN", 12800))
-    # epoch 0: calibration (no injection); epoch 1: first injected epoch;
-    # 2+: DBS reaction — the minimum meaningful A/B needs 4 on-arm epochs
-    epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
     ws = int(os.environ.get("BENCH_WS", 4))
-
     bundle = load_dataset("cifar10", n_train=n_train, n_test=512)
     factors = [3.0] + [1.0] * (ws - 1)
 
-    def arm(dbs_on: bool, n_epochs: int):
-        cfg = Config(
-            debug=False,
-            world_size=ws,
-            batch_size=512,
-            learning_rate=0.01,
-            epoch_size=n_epochs,
-            dataset="cifar10",
-            model="densenet",
-            dynamic_batch_size=dbs_on,
-            fault_tolerance=True,
-            fault_mode="compute",
-            bucket=32,
-        )
-        tr = Trainer(
-            cfg,
-            bundle=bundle,
-            injector=StaticStragglerInjector(factors, mode="compute"),
-            log_to_file=False,
-        )
-        walls = [tr.run_epoch(e)["epoch_wall"] for e in range(n_epochs)]
-        return walls
+    cfg = Config(
+        debug=False,
+        world_size=ws,
+        batch_size=512,
+        learning_rate=0.01,
+        epoch_size=n_epochs,
+        dataset="cifar10",
+        model="densenet",
+        dynamic_batch_size=dbs_on,
+        fault_tolerance=True,
+        fault_mode="compute",
+        bucket=32,
+    )
+    tr = Trainer(
+        cfg,
+        bundle=bundle,
+        injector=StaticStragglerInjector(factors, mode="compute"),
+        log_to_file=False,
+    )
+    walls = [tr.run_epoch(e)["epoch_wall"] for e in range(n_epochs)]
+    with open(out_path, "w") as f:
+        json.dump({"walls": walls}, f)
+
+
+def run_arm_with_retries(dbs_on: bool, n_epochs: int, retries: int):
+    for attempt in range(retries):
+        with tempfile.NamedTemporaryFile(
+            mode="r", suffix=".json", delete=False
+        ) as tf:
+            out_path = tf.name
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.abspath(__file__),
+                    "--arm",
+                    "on" if dbs_on else "off",
+                    "--epochs",
+                    str(n_epochs),
+                    "--out",
+                    out_path,
+                ],
+                capture_output=True,
+                text=True,
+                timeout=int(os.environ.get("BENCH_ARM_TIMEOUT", 5400)),
+            )
+            if proc.returncode == 0:
+                with open(out_path) as f:
+                    return json.load(f)["walls"]
+            sys.stderr.write(
+                f"[bench] arm dbs={dbs_on} attempt {attempt + 1} failed "
+                f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"[bench] arm dbs={dbs_on} attempt {attempt + 1} timed out\n"
+            )
+        finally:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+        if attempt < retries - 1:
+            time.sleep(30)  # give a crashed TPU runtime/tunnel time to recover
+    raise RuntimeError(f"arm dbs={dbs_on} failed after {retries} attempts")
+
+
+def main() -> int:
+    import numpy as np
+
+    if "--arm" in sys.argv:
+        i = sys.argv.index("--arm")
+        dbs_on = sys.argv[i + 1] == "on"
+        n_epochs = int(sys.argv[sys.argv.index("--epochs") + 1])
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+        run_arm(dbs_on, n_epochs, out_path)
+        return 0
+
+    # epoch 0: calibration (no injection); epoch 1: first injected epoch;
+    # 2+: DBS reaction — the minimum meaningful A/B needs 4 on-arm epochs
+    epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
+    retries = int(os.environ.get("BENCH_RETRIES", 3))
 
     # Epoch 0 of each arm is injection-free (cost calibration) and epoch 1 is
     # the first injected epoch; steady state is the tail.
-    walls_off = arm(False, max(3, epochs - 2))
-    walls_on = arm(True, epochs)
+    walls_off = run_arm_with_retries(False, max(3, epochs - 2), retries)
+    walls_on = run_arm_with_retries(True, epochs, retries)
     off_steady = float(np.min(walls_off[1:]))
     on_steady = float(np.min(walls_on[2:]))
     speedup = off_steady / on_steady
@@ -80,9 +142,8 @@ def main() -> int:
                 "detail": {
                     "dbs_off_epochs_s": [round(w, 4) for w in walls_off],
                     "dbs_on_epochs_s": [round(w, 4) for w in walls_on],
-                    "n_train": n_train,
-                    "world_size": ws,
-                    "devices": len(__import__("jax").devices()),
+                    "n_train": int(os.environ.get("BENCH_NTRAIN", 12800)),
+                    "world_size": int(os.environ.get("BENCH_WS", 4)),
                 },
             }
         )

@@ -55,6 +55,37 @@ def integer_batch_split(shares: np.ndarray, global_batch: int) -> np.ndarray:
     return floors.astype(np.int64)
 
 
+def quantize_batches(
+    batch_sizes: np.ndarray, bucket: int, global_batch: int
+) -> np.ndarray:
+    """Snap integer batch sizes to multiples of ``bucket`` (each worker >= one
+    bucket), redistributing by largest remainder so the total stays within the
+    global batch.
+
+    TPU-native extension (no reference counterpart): with snapped sizes the
+    padded static shape equals the true batch, so the compiled-shape universe
+    is the fixed ladder {bucket, 2*bucket, ...} — XLA compiles each rung once
+    per run — and sub-bucket noise in the measured times cannot churn shapes.
+    """
+    b = np.asarray(batch_sizes, dtype=np.int64)
+    n = len(b)
+    units_total = int(global_batch) // int(bucket)
+    if units_total < n:
+        # a bucket per worker would exceed the global batch — snapping is not
+        # applicable at this scale; keep the exact split
+        return b
+    units = integer_batch_split(b.astype(np.float64), units_total)
+    # every worker keeps at least one bucket: steal from the largest
+    for i in range(n):
+        while units[i] < 1:
+            j = int(np.argmax(units))
+            if units[j] <= 1:
+                break
+            units[j] -= 1
+            units[i] += 1
+    return units * int(bucket)
+
+
 def rebalance(
     node_times: np.ndarray,
     shares: np.ndarray,

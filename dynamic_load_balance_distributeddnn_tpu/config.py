@@ -68,6 +68,13 @@ class Config:
                                        # keeping real per-worker compute ∝ batch
     capacity_factor: float = 2.0       # max worker share = factor/world_size;
                                        # bounds memory of the padded fast path
+    snap_to_bucket: bool = True        # quantize per-worker batches to bucket
+                                       # multiples: padded shape == true batch,
+                                       # shape universe = a fixed ladder, so
+                                       # time noise can't churn XLA compiles
+    time_smoothing: float = 0.0        # EMA factor on the measured node-time
+                                       # vector (0 = off, exact reference
+                                       # semantics: raw last-epoch times)
     fault_mode: str = "virtual"        # "virtual": add simulated seconds to the
                                        # measured time vector (exact reference
                                        # semantics, dbs.py:94-129);
@@ -159,6 +166,8 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--bucket", type=int, default=d.bucket)
     p.add_argument("--capacity_factor", type=float, default=d.capacity_factor)
+    p.add_argument("--snap_to_bucket", type=str2bool, default=d.snap_to_bucket)
+    p.add_argument("--time_smoothing", type=float, default=d.time_smoothing)
     p.add_argument("--fault_mode", type=str, default=d.fault_mode, choices=["virtual", "compute"])
     p.add_argument("--precision", type=str, default=d.precision, choices=["float32", "bfloat16"])
     p.add_argument("--data_dir", type=str, default=d.data_dir)

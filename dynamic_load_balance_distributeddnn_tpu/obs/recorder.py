@@ -29,6 +29,24 @@ SERIES = (
 )
 
 
+def _pythonize(v):
+    """Recursively coerce numpy/jax scalars and arrays to plain Python so the
+    series stay JSON-serializable regardless of which execution path (fused,
+    elastic, multi-host allgather) produced them."""
+    if isinstance(v, np.ndarray):
+        return v.tolist() if v.ndim else v.item()
+    if isinstance(v, (np.floating, np.integer, np.bool_)):
+        return v.item()
+    if isinstance(v, (list, tuple)):
+        return [_pythonize(x) for x in v]
+    if hasattr(v, "item") and not isinstance(v, (int, float, bool, str)):
+        try:
+            return v.item()
+        except Exception:
+            return v
+    return v
+
+
 class MetricsRecorder:
     def __init__(self):
         self.data: Dict[str, List] = {k: [] for k in SERIES}
@@ -38,10 +56,7 @@ class MetricsRecorder:
         if missing:
             raise ValueError(f"missing series: {sorted(missing)}")
         for k in SERIES:
-            v = kw[k]
-            if isinstance(v, np.ndarray):
-                v = v.tolist() if v.ndim else float(v)
-            self.data[k].append(v)
+            self.data[k].append(_pythonize(kw[k]))
 
     def save(self, stat_dir: str, base_filename: str, rank: int = 0) -> str:
         os.makedirs(stat_dir, exist_ok=True)

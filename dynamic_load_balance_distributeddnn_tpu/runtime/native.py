@@ -46,6 +46,10 @@ def _build(src_dir: str) -> Optional[str]:
         return None
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
+    # Compile to a process-unique temp file and rename atomically: concurrent
+    # processes (e.g. multi-host workers) may race to build, and rewriting a
+    # .so another process has dlopen'd is undefined behavior.
+    tmp = f"{out}.{os.getpid()}.tmp"
     try:
         subprocess.run(
             [
@@ -56,15 +60,22 @@ def _build(src_dir: str) -> Optional[str]:
                 "-shared",
                 "-pthread",
                 "-o",
-                out,
+                tmp,
                 src,
             ],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, out)
     except (OSError, subprocess.SubprocessError):
         return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
     return out
 
 
@@ -139,6 +150,11 @@ def take_rows(data: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """
     lib = _load()
     idx = np.ascontiguousarray(idx, dtype=np.int64)
+    # Uniform bounds semantics on both backends: no negative/out-of-range
+    # indices (numpy's silent negative-index wrapping would otherwise make the
+    # fallback diverge from the C++ bounds check).
+    if idx.size and (idx.min() < 0 or idx.max() >= data.shape[0]):
+        raise ValueError("take_rows: index out of range")
     if lib is None:
         return np.take(data, idx, axis=0)
     if not data.flags["C_CONTIGUOUS"]:

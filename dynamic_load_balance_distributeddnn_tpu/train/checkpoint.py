@@ -34,6 +34,10 @@ def save_checkpoint(ckpt_dir: str, epoch: int, state, controller: Dict[str, Any]
     mgr.save(epoch, args=ocp.args.StandardSave(state))
     mgr.wait_until_finished()
     mgr.close()
+    if jax.process_index() != 0:
+        # orbax coordinates the distributed array save across processes; the
+        # controller sidecar is replicated host state, written once.
+        return
     clean = {
         k: (np.asarray(v).tolist() if not np.isscalar(v) else float(v))
         for k, v in controller.items()

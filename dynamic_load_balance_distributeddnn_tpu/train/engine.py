@@ -38,6 +38,7 @@ from dynamic_load_balance_distributeddnn_tpu.balance import (
     integer_batch_split,
     rebalance,
 )
+from dynamic_load_balance_distributeddnn_tpu.balance.solver import quantize_batches
 from dynamic_load_balance_distributeddnn_tpu.config import Config
 from dynamic_load_balance_distributeddnn_tpu.data import (
     DatasetBundle,
@@ -70,6 +71,10 @@ class Trainer:
     """Vision-model trainer (the Transformer-LM path lives in
     train/lm_engine.py and shares this controller's balance machinery)."""
 
+    # Subclasses opt out of bucket snapping (the LM path's "batch" is a small
+    # column count where bucket quantization would distort the balance).
+    SNAP_BATCHES = True
+
     def __init__(
         self,
         cfg: Config,
@@ -87,20 +92,59 @@ class Trainer:
         self.timing_model = timing_model
         self.logger = logger or init_logger(cfg, to_file=log_to_file)
 
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "multi-host worker topology is not wired up yet: each host "
-                "must own a disjoint worker slice before exchange_times can "
-                "concatenate per-host contributions (balance/timing.py)"
+        # Multi-host: each process owns a contiguous slice of the global
+        # workers, mapped onto its LOCAL devices; the combine mesh spans every
+        # process's used devices (XLA collectives ride ICI within a host, DCN
+        # across — the reference's gloo ring analogue, SURVEY §5.8). All
+        # processes replicate the plan/solver deterministically, so the only
+        # cross-host traffic is gradients (in-step psum) and the per-epoch
+        # time vector (process_allgather in balance/timing.py).
+        self.n_proc = jax.process_count()
+        self.proc_id = jax.process_index()
+        if cfg.world_size % self.n_proc != 0:
+            raise ValueError(
+                f"world_size {cfg.world_size} must divide evenly across "
+                f"{self.n_proc} processes"
             )
-        all_devices = jax.devices()
-        device_ids = cfg.worker_device_ids(len(all_devices))
-        used = sorted(set(device_ids))
+        self.ws_local = cfg.world_size // self.n_proc
+        self.rank_lo = self.proc_id * self.ws_local
+
+        local_devices = sorted(jax.local_devices(), key=lambda d: d.id)
+        ids_global = cfg.worker_device_ids(len(local_devices))
+        ids_local = ids_global[self.rank_lo : self.rank_lo + self.ws_local]
+        used = sorted(set(ids_local))
+        if self.n_proc > 1:
+            # Every process must use the same local device ordinals, or the
+            # global meshes (built per-process below) would disagree and the
+            # collectives would hang. Validate instead of assuming.
+            for p in range(self.n_proc):
+                slice_p = ids_global[p * self.ws_local : (p + 1) * self.ws_local]
+                if sorted(set(slice_p)) != used:
+                    raise ValueError(
+                        "multi-host topology must be symmetric: every process "
+                        f"must map its workers onto the same local device "
+                        f"ordinals (process 0 uses {used}, process {p} would "
+                        f"use {sorted(set(slice_p))}); adjust the device map"
+                    )
         self.topology = WorkerTopology.build(
-            cfg.world_size, [all_devices[i] for i in used], [used.index(i) for i in device_ids]
+            self.ws_local,
+            [local_devices[i] for i in used],
+            [used.index(i) for i in ids_local],
         )
-        self.mesh = data_mesh(self.topology.devices)
-        self.n_dev = len(self.topology.devices)
+        if self.n_proc == 1:
+            mesh_devices = list(self.topology.devices)
+        else:
+            # Symmetric hosts: every process contributes the same local device
+            # ordinals, ordered by process index then device id.
+            by_proc: Dict[int, list] = {}
+            for d in jax.devices():
+                by_proc.setdefault(d.process_index, []).append(d)
+            mesh_devices = []
+            for p in sorted(by_proc):
+                proc_devs = sorted(by_proc[p], key=lambda d: d.id)
+                mesh_devices.extend(proc_devs[i] for i in used)
+        self.mesh = data_mesh(mesh_devices)
+        self.n_dev = len(mesh_devices)
 
         self._setup_data(bundle)
         self._setup_model()
@@ -205,7 +249,9 @@ class Trainer:
         finally:
             if cfg.profile_dir:
                 jax.profiler.stop_trace()
-        self.recorder.save(cfg.stat_dir, cfg.base_filename())
+        if self.proc_id == 0:
+            # rank-0-only artifact, like the reference (dbs.py:440-442)
+            self.recorder.save(cfg.stat_dir, cfg.base_filename())
         self.logger.info(f"Total wallclock: {self.total_wallclock:.3f}s")
         return self.recorder
 
@@ -261,6 +307,11 @@ class Trainer:
             self.shares, batch_sizes = rebalance(
                 self.node_times, self.shares, cfg.batch_size, max_share=max_share
             )
+            if cfg.snap_to_bucket and self.SNAP_BATCHES:
+                batch_sizes = quantize_batches(
+                    batch_sizes, cfg.bucket, cfg.batch_size
+                )
+                self.shares = batch_sizes.astype(np.float64) / batch_sizes.sum()
             self.logger.info(
                 f"Epoch {epoch}: adjusted shares to {np.round(self.shares, 4).tolist()}"
             )
@@ -296,7 +347,21 @@ class Trainer:
             self.timekeeper.compute_s * faults.time_multipliers
             + self.timekeeper.injected_s
         )
-        self.node_times = exchange_times(node_times)
+        # Each process contributes its own workers' slice; exchange_times
+        # concatenates them rank-ordered (single-process: identity).
+        fresh = exchange_times(node_times[self.rank_lo : self.rank_lo + self.ws_local])
+        if cfg.time_smoothing > 0.0 and epoch > 0:
+            # EMA damping against probe noise (extension; 0 = reference-exact)
+            a = cfg.time_smoothing
+            self.node_times = a * self.node_times + (1.0 - a) * fresh
+        else:
+            self.node_times = fresh
+        if self.n_proc > 1 and np.isfinite(
+            self.per_example_cost[self.rank_lo : self.rank_lo + self.ws_local]
+        ).all():
+            self.per_example_cost = exchange_times(
+                self.per_example_cost[self.rank_lo : self.rank_lo + self.ws_local]
+            )
         self.logger.info(
             f"Epoch {epoch}: node times {np.round(self.node_times, 4).tolist()}, "
             f"train_loss {train_metrics['loss']:.4f}, val_loss {val_loss:.4f}, "
@@ -332,6 +397,7 @@ class Trainer:
             not self.cfg.dynamic_batch_size
             and plan.is_uniform()
             and self.topology.one_worker_per_device
+            and self.n_dev == self.cfg.world_size
             and self.timing_model is None
             # compute-mode injection needs per-worker probes (elastic path),
             # so straggler A/B arms stay comparable
@@ -341,21 +407,41 @@ class Trainer:
     def _train_epoch_fused(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
         self.timekeeper.reset()
-        data = [self._worker_inputs(plan, r) for r in range(cfg.world_size)]
-        # [steps, ws*b_pad, ...] global layout: worker r owns slice r
+        # [steps, ws*b_pad, ...] global layout: worker r owns slice r; each
+        # process materializes only its own workers' slice.
+        data = [
+            self._worker_inputs(plan, self.rank_lo + r) for r in range(self.ws_local)
+        ]
         xs = np.concatenate([d[0] for d in data], axis=1)
         ys = np.concatenate([d[1] for d in data], axis=1)
         ws_ = np.concatenate([d[2] for d in data], axis=1)
         from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
 
         mesh = self.mesh
-        xs = jax.device_put(xs, batch_sharding(mesh, xs.ndim, axis_dim=1))
-        ys = jax.device_put(ys, batch_sharding(mesh, ys.ndim, axis_dim=1))
-        ws_ = jax.device_put(ws_, batch_sharding(mesh, ws_.ndim, axis_dim=1))
-        slow = jax.device_put(
-            faults.slow_iters_per_step.astype(np.int32),
-            batch_sharding(mesh, 1),
-        )
+        if self.n_proc == 1:
+            xs = jax.device_put(xs, batch_sharding(mesh, xs.ndim, axis_dim=1))
+            ys = jax.device_put(ys, batch_sharding(mesh, ys.ndim, axis_dim=1))
+            ws_ = jax.device_put(ws_, batch_sharding(mesh, ws_.ndim, axis_dim=1))
+            slow = jax.device_put(
+                faults.slow_iters_per_step.astype(np.int32),
+                batch_sharding(mesh, 1),
+            )
+        else:
+            xs = jax.make_array_from_process_local_data(
+                batch_sharding(mesh, xs.ndim, axis_dim=1), xs
+            )
+            ys = jax.make_array_from_process_local_data(
+                batch_sharding(mesh, ys.ndim, axis_dim=1), ys
+            )
+            ws_ = jax.make_array_from_process_local_data(
+                batch_sharding(mesh, ws_.ndim, axis_dim=1), ws_
+            )
+            slow = jax.make_array_from_process_local_data(
+                batch_sharding(mesh, 1),
+                faults.slow_iters_per_step.astype(np.int32)[
+                    self.rank_lo : self.rank_lo + self.ws_local
+                ],
+            )
         self.state, metrics = self.steps.fused_epoch(
             self.state, xs, ys, ws_, slow, jnp.int32(cfg.seed * 31 + epoch)
         )
@@ -398,7 +484,10 @@ class Trainer:
         topo = self.topology
         self.timekeeper.reset()
 
-        data = [self._worker_inputs(plan, r) for r in range(cfg.world_size)]
+        # Local topo ranks r (0..ws_local-1) own global worker rank_lo + r.
+        data = [
+            self._worker_inputs(plan, self.rank_lo + r) for r in range(self.ws_local)
+        ]
         groups = topo.groups
         dev_order = topo.used_device_indices
         aux_acc: List = []
@@ -413,13 +502,14 @@ class Trainer:
                 dev = topo.devices[d]
                 for r in groups[d]:
                     x, y, w = data[r]
+                    gr = self.rank_lo + r
                     staged[r] = (
                         jax.device_put(x[s], dev),
                         jax.device_put(y[s], dev),
                         jax.device_put(w[s], dev),
-                        jax.device_put(wkeys[s * cfg.world_size + r], dev),
+                        jax.device_put(wkeys[s * cfg.world_size + gr], dev),
                         jax.device_put(
-                            jnp.int32(faults.slow_iters_per_step[r]), dev
+                            jnp.int32(faults.slow_iters_per_step[gr]), dev
                         ),
                     )
             views = shard_views(self.state.params, self.topology.devices)
@@ -461,6 +551,14 @@ class Trainer:
         wloss = float(np.sum([float(a[0]) for a in aux_acc]))
         loss_sum = float(np.sum([float(a[1]) for a in aux_acc]))
         count = float(np.sum([float(a[2]) for a in aux_acc]))
+        if self.n_proc > 1:
+            # Per-process partial sums -> global (per-epoch metadata, host path)
+            from jax.experimental import multihost_utils
+
+            sums = multihost_utils.process_allgather(
+                np.array([wloss, loss_sum, count], dtype=np.float64)
+            )
+            wloss, loss_sum, count = np.asarray(sums).reshape(-1, 3).sum(axis=0)
         return {
             "loss": loss_sum / max(count, 1.0),
             "wloss": wloss / max(plan.num_steps, 1),
@@ -468,27 +566,42 @@ class Trainer:
         }
 
     def _probe_workers(
-        self, plan, data, faults: EpochFaults, epoch: int, reps: int = 2
+        self, plan, data, faults: EpochFaults, epoch: int, reps: int = 3
     ) -> float:
         """Time each worker's step standalone (blocking, min over ``reps``)
         plus one combine — the balancer's signal. Called after the epoch's
-        dispatch queue has drained, with executables warm."""
+        dispatch queue has drained. A full untimed warm pass runs first so
+        every shape is compiled before any timing starts — otherwise a
+        background compile of one worker's fresh shape contaminates another
+        worker's host-side wall clock."""
         topo = self.topology
         cfg = self.cfg
         key = jax.random.PRNGKey(cfg.seed * 104729 + epoch)
         views = shard_views(self.state.params, topo.devices)
-        partials = {}
+        staged = {}
         for d in topo.used_device_indices:
             dev = topo.devices[d]
-            acc = None
             for r in topo.groups[d]:
                 x, y, w = data[r]
-                xs = jax.device_put(x[0], dev)
-                ys = jax.device_put(y[0], dev)
-                ws_ = jax.device_put(w[0], dev)
-                k = jax.device_put(key, dev)
-                slow = jax.device_put(jnp.int32(faults.slow_iters_per_step[r]), dev)
-                jax.block_until_ready((xs, ys, ws_))
+                gr = self.rank_lo + r
+                staged[r] = (
+                    jax.device_put(x[0], dev),
+                    jax.device_put(y[0], dev),
+                    jax.device_put(w[0], dev),
+                    jax.device_put(key, dev),
+                    jax.device_put(jnp.int32(faults.slow_iters_per_step[gr]), dev),
+                    d,
+                )
+        # warm pass: compile + execute everything once, untimed
+        for r, (xs, ys, ws_, k, slow, d) in staged.items():
+            _, aux = self.steps.worker_step_first(views[d], xs, ys, ws_, k, slow)
+            jax.block_until_ready(aux)
+        partials = {}
+        for d in topo.used_device_indices:
+            acc = None
+            for r in topo.groups[d]:
+                xs, ys, ws_, k, slow, _ = staged[r]
+                gr = self.rank_lo + r
                 # probe with the non-donating first-step executable so reps
                 # are safe; each worker is measured standalone
                 dt = float("inf")
@@ -499,12 +612,12 @@ class Trainer:
                     )
                     jax.block_until_ready(aux)
                     dt = min(dt, time.perf_counter() - t0)
-                w_plan = plan.workers[r]
-                self.timekeeper.add_compute(r, dt * w_plan.steps)
-                clean = dt - float(faults.slow_iters_per_step[r]) * (
+                w_plan = plan.workers[gr]
+                self.timekeeper.add_compute(gr, dt * w_plan.steps)
+                clean = dt - float(faults.slow_iters_per_step[gr]) * (
                     calibrate_iter_cost() if self._needs_iter_cost else 0.0
                 )
-                self.per_example_cost[r] = max(clean, 1e-9) / max(w_plan.batch_size, 1)
+                self.per_example_cost[gr] = max(clean, 1e-9) / max(w_plan.batch_size, 1)
             partials[d] = acc
         stacked = stack_partials(
             [partials[d] for d in topo.used_device_indices], self.mesh

@@ -44,6 +44,8 @@ from dynamic_load_balance_distributeddnn_tpu.train.steps import StepLibrary, sha
 
 
 class LMTrainer(Trainer):
+    SNAP_BATCHES = False  # columns, not examples — keep the exact split
+
     # Reference LM hyperparameters (dbs.py:337-343)
     EMSIZE = 200
     NHEAD = 2

@@ -334,17 +334,23 @@ def stack_partials(partials_by_device, mesh: Mesh):
     [1, ...] axis, living on its device) into global arrays sharded over the
     mesh — the input of combine_update. This is the moment the reference would
     enter its gloo allreduce (dbs.py:296); here it is just array surgery, the
-    actual reduction happens inside the combine_update collective."""
-    n = len(partials_by_device)
-    assert n == len(mesh.devices.flat)
+    actual reduction happens inside the combine_update collective.
+
+    Multi-host: each process passes only its local devices' partials (the
+    mesh's addressable slice); JAX matches shards to mesh positions by device,
+    and the cross-host reduction happens inside the combine collective over
+    DCN."""
+    n_local = len(partials_by_device)
+    n_global = len(mesh.devices.flat)
+    assert n_local == len([d for d in mesh.devices.flat if d.process_index == jax.process_index()])
     sharding = NamedSharding(mesh, P(DATA_AXIS))
 
     leaves_by_dev = [jax.tree_util.tree_leaves(p) for p in partials_by_device]
     treedef = jax.tree_util.tree_structure(partials_by_device[0])
     stacked_leaves = []
     for li in range(len(leaves_by_dev[0])):
-        shards = [leaves_by_dev[d][li] for d in range(n)]
-        shape = (n,) + tuple(shards[0].shape[1:])
+        shards = [leaves_by_dev[d][li] for d in range(n_local)]
+        shape = (n_global,) + tuple(shards[0].shape[1:])
         stacked_leaves.append(
             jax.make_array_from_single_device_arrays(shape, sharding, shards)
         )

@@ -1,0 +1,82 @@
+"""Multi-host worker: one process of a 2-process × 2-virtual-device run.
+
+Launched by tests/test_multihost.py as
+``python _mh_worker.py <proc_id> <num_procs> <port>``. Trains MnistNet on a
+synthetic bundle with ws=4 workers split across the processes, exercising
+both the elastic (dbs on, deterministic timing model) and fused (dbs off)
+paths over the global mesh, then prints one JSON line of results for the
+parent to cross-check.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def main() -> None:
+    proc_id, num_procs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    import numpy as np
+
+    from dynamic_load_balance_distributeddnn_tpu.config import Config
+    from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+    from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+    bundle = synthetic_dataset("mnist", n_train=512, n_test=128)
+
+    # --- elastic path: dbs on, worker 0 modeled 3x slower ------------------
+    cfg = Config(
+        debug=True,
+        world_size=4,
+        batch_size=128,
+        learning_rate=0.05,
+        epoch_size=3,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=True,
+        bucket=8,
+    )
+
+    factors = np.array([3.0, 1.0, 1.0, 1.0])
+
+    def timing_model(plan):
+        return factors * np.array([w.batch_size * w.steps for w in plan.workers])
+
+    tr = Trainer(cfg, bundle=bundle, timing_model=timing_model, log_to_file=False)
+    rec = tr.run()
+    shares = np.asarray(tr.shares)
+    losses = [float(e) for e in rec.data["train_loss"]]
+
+    # --- fused path: dbs off, uniform plan, one worker per device ----------
+    cfg2 = cfg.replace(dynamic_batch_size=False, epoch_size=1)
+    tr2 = Trainer(cfg2, bundle=bundle, log_to_file=False)
+    out2 = tr2.run_epoch(0)
+
+    print(
+        "RESULT "
+        + json.dumps(
+            {
+                "proc": proc_id,
+                "shares": [round(float(s), 6) for s in shares],
+                "losses": [round(fl, 6) for fl in losses],
+                "fused_loss": round(float(out2["loss"]), 6),
+                "node_times": [round(float(t), 6) for t in tr.node_times],
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

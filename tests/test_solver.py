@@ -87,3 +87,38 @@ def test_rejects_bad_input():
         rebalance(np.array([1.0, -1.0]), np.array([0.5, 0.5]), 64)
     with pytest.raises(ValueError):
         rebalance(np.array([1.0]), np.array([0.5, 0.5]), 64)
+
+
+def test_quantize_batches_multiples_and_sum():
+    from dynamic_load_balance_distributeddnn_tpu.balance.solver import quantize_batches
+
+    b = quantize_batches(np.array([51, 154, 154, 153]), 32, 512)
+    assert (b % 32 == 0).all()
+    assert b.sum() <= 512
+    assert (b >= 32).all()
+    # proportions roughly preserved: smallest worker stays smallest
+    assert b[0] == b.min()
+
+
+def test_quantize_batches_minimum_one_bucket():
+    from dynamic_load_balance_distributeddnn_tpu.balance.solver import quantize_batches
+
+    b = quantize_batches(np.array([1, 1, 1000]), 16, 256)
+    assert (b >= 16).all()
+    assert b.sum() <= 256
+
+
+def test_quantize_batches_uniform_exact():
+    from dynamic_load_balance_distributeddnn_tpu.balance.solver import quantize_batches
+
+    b = quantize_batches(np.array([128, 128, 128, 128]), 32, 512)
+    assert b.tolist() == [128, 128, 128, 128]
+
+
+def test_quantize_batches_infeasible_returns_exact():
+    from dynamic_load_balance_distributeddnn_tpu.balance.solver import quantize_batches
+
+    # a bucket per worker would exceed B -> snapping skipped entirely
+    b = np.array([8, 8, 8, 8, 8, 8, 8, 8])
+    out = quantize_batches(b, 16, 64)
+    assert out.tolist() == b.tolist()

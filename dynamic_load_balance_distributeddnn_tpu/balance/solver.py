@@ -75,7 +75,14 @@ def quantize_batches(
         # applicable at this scale; keep the exact split
         return b
     units = integer_batch_split(b.astype(np.float64), units_total)
-    # every worker keeps at least one bucket: steal from the largest
+    # Every worker keeps at least one bucket. First hand out units the 0.5-
+    # cutoff left unassigned (sum may be < units_total), then steal from the
+    # largest. Feasible because units_total >= n.
+    leftover = units_total - int(units.sum())
+    for i in range(n):
+        if units[i] < 1 and leftover > 0:
+            units[i] += 1
+            leftover -= 1
     for i in range(n):
         while units[i] < 1:
             j = int(np.argmax(units))

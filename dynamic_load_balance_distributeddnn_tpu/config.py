@@ -89,7 +89,12 @@ class Config:
     grad_clip: float = 0.0             # LM path uses 0.25 (dbs.py:274)
     profile_dir: str = ""              # non-empty → jax.profiler traces
     use_pallas: bool = False           # route GroupNorm/xent through the
-                                       # Pallas kernels (ops/pallas/)
+                                       # Pallas kernels (ops/pallas/) —
+                                       # numerics-preserving kernel routing
+    use_flash_attention: bool = False  # LM attention via the Pallas flash
+                                       # kernel; NOTE: drops attention-prob
+                                       # dropout (a semantics change, hence a
+                                       # separate knob from use_pallas)
 
     def __post_init__(self):
         if self.model not in MODELS:
@@ -179,6 +184,7 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad_clip", type=float, default=d.grad_clip)
     p.add_argument("--profile_dir", type=str, default=d.profile_dir)
     p.add_argument("--use_pallas", type=str2bool, default=d.use_pallas)
+    p.add_argument("--use_flash_attention", type=str2bool, default=d.use_flash_attention)
     return p
 
 

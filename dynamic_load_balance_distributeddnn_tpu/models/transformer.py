@@ -13,6 +13,8 @@ Layout is batch-major [B, T] (TPU-friendly), vs the reference's [T, B].
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import flax.linen as nn
@@ -29,6 +31,41 @@ def sinusoidal_positions(max_len: int, d_model: int) -> np.ndarray:
     return pe
 
 
+class FlashSelfAttention(nn.Module):
+    """Causal multi-head self-attention over the Pallas flash kernel
+    (ops/pallas/flash_attention.py): O(T) memory, MXU-tiled matmuls — the
+    long-context replacement for materialized-score attention. Attention-prob
+    dropout is not applied inside the kernel (the residual-path dropouts in
+    the encoder layer remain)."""
+
+    num_heads: int
+    qkv_features: int
+
+    @nn.compact
+    def __call__(self, x):
+        from dynamic_load_balance_distributeddnn_tpu.ops.pallas import (
+            flash_attention,
+        )
+
+        h = self.num_heads
+        hd = self.qkv_features // h
+        dense = functools.partial(
+            nn.DenseGeneral, features=(h, hd), axis=-1
+        )
+        q = dense(name="query")(x)  # [B, T, H, hd]
+        k = dense(name="key")(x)
+        v = dense(name="value")(x)
+        o = flash_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            causal=True,
+        ).transpose(0, 2, 1, 3)
+        return nn.DenseGeneral(
+            features=self.qkv_features, axis=(-2, -1), name="out"
+        )(o)
+
+
 class EncoderLayer(nn.Module):
     """Post-LN transformer encoder layer (torch convention)."""
 
@@ -36,15 +73,19 @@ class EncoderLayer(nn.Module):
     nhead: int
     d_ff: int
     dropout: float
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x, mask, train: bool):
-        attn = nn.MultiHeadDotProductAttention(
-            num_heads=self.nhead,
-            qkv_features=self.d_model,
-            dropout_rate=self.dropout,
-            deterministic=not train,
-        )(x, x, mask=mask)
+        if self.use_flash:
+            attn = FlashSelfAttention(self.nhead, self.d_model)(x)
+        else:
+            attn = nn.MultiHeadDotProductAttention(
+                num_heads=self.nhead,
+                qkv_features=self.d_model,
+                dropout_rate=self.dropout,
+                deterministic=not train,
+            )(x, x, mask=mask)
         attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
         x = nn.LayerNorm()(x + attn)
 
@@ -64,6 +105,7 @@ class TransformerLM(nn.Module):
     nlayers: int = 2
     dropout: float = 0.2
     max_len: int = 5000
+    use_flash: bool = False  # route attention through the Pallas flash kernel
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -82,11 +124,11 @@ class TransformerLM(nn.Module):
         x = x + pe[None, :t, :]
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
-        causal = nn.make_causal_mask(tokens)
+        causal = None if self.use_flash else nn.make_causal_mask(tokens)
         for _ in range(self.nlayers):
-            x = EncoderLayer(self.ninp, self.nhead, self.nhid, self.dropout)(
-                x, causal, train
-            )
+            x = EncoderLayer(
+                self.ninp, self.nhead, self.nhid, self.dropout, self.use_flash
+            )(x, causal, train)
         # Raw logits; the loss layer applies softmax cross-entropy, which on
         # logits equals the reference's NLLLoss-on-log_softmax composition
         # (dbs.py:371-372) and lets the fused Pallas xent kernel take the

@@ -1,0 +1,355 @@
+"""Flash attention — blockwise streaming-softmax attention as Pallas kernels.
+
+The reference's attention is stock ``nn.TransformerEncoder`` (reference
+Net/Transformer.py:57-64), which materializes the full [T, T] score matrix in
+HBM. This kernel is the TPU-native replacement for long sequences: the grid
+iterates (batch·head, query tile, key tile); each program holds one
+[block_q, D] query tile and one [block_k, D] key/value tile in VMEM — VMEM
+use is O(block·D), independent of T — and softmax is accumulated across key
+tiles in VMEM scratch with the numerically stable running (max, sum)
+recurrence. Causally dead tiles (whole key block above the diagonal) skip
+their matmuls via predication.
+
+Backward is the standard flash recomputation: the forward saves only the
+per-row log-sum-exp; dK/dV and dQ are computed by two kernels that replay the
+score tiles (grid over KV tiles for dK/dV, over Q tiles for dQ) using the
+delta = rowsum(dO ∘ O) trick.
+
+Shapes: q, k, v are [B, H, T, D]. T and D are padded internally to tile
+multiples; padded key rows are masked out of the softmax, padded query rows
+produce garbage that is sliced away. Accumulation is f32 regardless of input
+dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dynamic_load_balance_distributeddnn_tpu.ops import pallas as _pk
+
+_NEG_INF = -1e30
+_LANES = 128  # stat scratch lane width (min TPU lane tile)
+
+
+def _scores(q, k, scale, q_tile, k_tile, block_q, block_k, causal, t_real):
+    """Masked scaled scores for one (q tile, k tile) pair, f32 [BQ, BK]."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    q_pos = q_tile * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = k_tile * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = k_pos < t_real
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+    return jnp.where(mask, s, _NEG_INF)
+
+
+def _attn_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+    *, scale: float, causal: bool, t_real: int, block_q: int, block_k: int
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def tile():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = _scores(q, k, scale, i, j, block_q, block_k, causal, t_real)
+        m_prev = m_ref[:, :1]  # [BQ, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # skip tiles entirely above the diagonal: no q position can see them
+        @pl.when(i * block_q + block_q - 1 >= j * block_k)
+        def _():
+            tile()
+    else:
+        tile()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(l_safe[:, 0])).astype(jnp.float32)
+
+
+def _attn_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, t_real: int, block_q: int, block_k: int
+):
+    j = pl.program_id(1)  # kv tile
+    i = pl.program_id(2)  # q tile
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def tile():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].astype(jnp.float32)
+        delta = delta_ref[0].astype(jnp.float32)
+        s = _scores(q, k, scale, i, j, block_q, block_k, causal, t_real)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        @pl.when(i * block_q + block_q - 1 >= j * block_k)
+        def _():
+            tile()
+    else:
+        tile()
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _attn_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool, t_real: int, block_q: int, block_k: int
+):
+    i = pl.program_id(1)  # q tile
+    j = pl.program_id(2)  # kv tile
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def tile():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].astype(jnp.float32)
+        delta = delta_ref[0].astype(jnp.float32)
+        s = _scores(q, k, scale, i, j, block_q, block_k, causal, t_real)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        @pl.when(i * block_q + block_q - 1 >= j * block_k)
+        def _():
+            tile()
+    else:
+        tile()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    bh, t_real, d_real = q.shape
+    scale = 1.0 / (d_real ** 0.5)
+    # one padded time axis divisible by BOTH tile sizes
+    lcm = math.lcm(block_q, block_k)
+    t_pad = -(-t_real // lcm) * lcm
+    qp = _pad_to(_pad_to(q, 2, 128), 1, t_pad)
+    kp = _pad_to(_pad_to(k, 2, 128), 1, t_pad)
+    vp = _pad_to(_pad_to(v, 2, 128), 1, t_pad)
+    d_pad = qp.shape[2]
+    nq = t_pad // block_q
+    nk = t_pad // block_k
+
+    kernel = functools.partial(
+        _attn_fwd_kernel,
+        scale=scale,
+        causal=causal,
+        t_real=t_real,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, d_pad), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :t_real, :d_real], lse, (qp, kp, vp, t_pad, d_pad)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    o, _, _ = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse, (qp, kp, vp, t_pad, d_pad) = _fwd_impl(
+        q, k, v, causal, block_q, block_k, interpret
+    )
+    return o, (qp, kp, vp, lse, o, t_pad, d_pad)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+    qp, kp, vp, lse, o, t_pad, d_pad = res
+    bh, t_real, d_real = o.shape
+    scale = 1.0 / (d_real ** 0.5)
+    dop = _pad_to(_pad_to(do, 2, d_pad), 1, t_pad)
+    # delta = rowsum(dO ∘ O) — one bandwidth pass, fused by XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = _pad_to(delta, 1, t_pad)
+
+    nk = t_pad // block_k
+    nq = t_pad // block_q
+    common = dict(
+        scale=scale,
+        causal=causal,
+        t_real=t_real,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_attn_bwd_dkv_kernel, **common),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), qp.dtype),
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), qp.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_attn_bwd_dq_kernel, **common),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), qp.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, delta)
+
+    return (
+        dq[:, :t_real, :d_real],
+        dk[:, :t_real, :d_real],
+        dv[:, :t_real, :d_real],
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Blockwise streaming-softmax attention, [B, H, T, D] -> [B, H, T, D].
+
+    Differentiable (custom VJP with flash recomputation). Runs as a Mosaic
+    kernel on TPU, interpreter elsewhere."""
+    if interpret is None:
+        interpret = _pk.interpret_default()
+    b, h, t, d = q.shape
+    t16 = -(-t // 16) * 16  # sublane-aligned cap for short sequences
+    block_q = min(block_q, t16)
+    block_k = min(block_k, t16)
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    o = _flash(qf, kf, vf, causal, block_q, block_k, interpret)
+    return o.reshape(b, h, t, d)

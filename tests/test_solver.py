@@ -122,3 +122,14 @@ def test_quantize_batches_infeasible_returns_exact():
     b = np.array([8, 8, 8, 8, 8, 8, 8, 8])
     out = quantize_batches(b, 16, 64)
     assert out.tolist() == b.tolist()
+
+
+def test_quantize_batches_never_zero_with_skew():
+    from dynamic_load_balance_distributeddnn_tpu.balance.solver import quantize_batches
+
+    # regression: the 0.5-cutoff used to leave units.sum() < n workers with 0
+    b1 = quantize_batches(np.array([10, 10, 10, 70]), 25, 100)
+    assert (b1 >= 25).all(), b1
+    b2 = quantize_batches(np.array([5, 5, 5, 5, 5, 5, 5, 221]), 32, 256)
+    assert (b2 >= 32).all(), b2
+    assert b2.sum() <= 256

@@ -16,7 +16,9 @@ Metric: steady-state epoch wall-clock with DBS on (seconds; lower is better).
 vs_baseline: speedup over the DBS-off arm (>1 means DBS wins).
 
 Environment knobs: BENCH_NTRAIN (default 12800), BENCH_EPOCHS (default 5),
-BENCH_WS (default 4), BENCH_RETRIES (default 3).
+BENCH_WS (default 4), BENCH_RETRIES (default 4), BENCH_ARM_TIMEOUT (seconds
+per arm attempt, default 5400), BENCH_INIT_TIMEOUT (seconds for TPU backend
+init before the arm aborts, default 300).
 """
 
 import json
@@ -31,6 +33,27 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "./.jax_cache")
 
 def run_arm(dbs_on: bool, n_epochs: int, out_path: str) -> None:
     """Subprocess entry: run one A/B arm and dump per-epoch walls to JSON."""
+    # Fail fast if the TPU runtime/tunnel is wedged: backend init has been
+    # observed to hang indefinitely after a TPU worker crash. A hung init
+    # should cost one retry (with backoff), not the whole arm timeout. The
+    # hang is inside PJRT C++ code, where Python signal handlers never run —
+    # so the watchdog is a daemon thread that hard-exits the process.
+    import threading
+
+    init_done = threading.Event()
+
+    def _watchdog():
+        if not init_done.wait(int(os.environ.get("BENCH_INIT_TIMEOUT", 300))):
+            sys.stderr.write("[bench] TPU backend init timed out; aborting arm\n")
+            sys.stderr.flush()
+            os._exit(17)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    import jax
+
+    jax.devices()
+    init_done.set()
+
     from dynamic_load_balance_distributeddnn_tpu.config import Config
     from dynamic_load_balance_distributeddnn_tpu.data import load_dataset
     from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
@@ -104,7 +127,9 @@ def run_arm_with_retries(dbs_on: bool, n_epochs: int, retries: int):
             except OSError:
                 pass
         if attempt < retries - 1:
-            time.sleep(30)  # give a crashed TPU runtime/tunnel time to recover
+            # progressive backoff: a crashed TPU runtime/tunnel can take
+            # minutes to come back (observed on this host)
+            time.sleep(min(60 * (attempt + 1), 240))
     raise RuntimeError(f"arm dbs={dbs_on} failed after {retries} attempts")
 
 
@@ -122,7 +147,7 @@ def main() -> int:
     # epoch 0: calibration (no injection); epoch 1: first injected epoch;
     # 2+: DBS reaction — the minimum meaningful A/B needs 4 on-arm epochs
     epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
-    retries = int(os.environ.get("BENCH_RETRIES", 3))
+    retries = int(os.environ.get("BENCH_RETRIES", 4))
 
     # Epoch 0 of each arm is injection-free (cost calibration) and epoch 1 is
     # the first injected epoch; steady state is the tail.

@@ -262,7 +262,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
     qp, kp, vp, lse, o, t_pad, d_pad = res
     bh, t_real, d_real = o.shape
     scale = 1.0 / (d_real ** 0.5)
-    dop = _pad_to(_pad_to(do, 2, d_pad), 1, t_pad)
+    dop = _pad_to(_pad_to(do, 2, 128), 1, t_pad)  # same policy as _fwd_impl
     # delta = rowsum(dO ∘ O) — one bandwidth pass, fused by XLA
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = _pad_to(delta, 1, t_pad)

@@ -31,6 +31,44 @@ def sinusoidal_positions(max_len: int, d_model: int) -> np.ndarray:
     return pe
 
 
+class RingSelfAttention(nn.Module):
+    """Causal multi-head self-attention over a SEQUENCE-SHARDED axis: the
+    local [B, T_local] slice attends to the full global sequence via the
+    ``ring_self_attention`` ppermute pipeline (parallel/ring.py). Must be
+    applied inside a ``shard_map`` whose mesh carries ``axis_name``.
+
+    Parameter tree (query/key/value/out DenseGenerals) is identical to
+    ``nn.MultiHeadDotProductAttention``'s, so weights are interchangeable
+    with the single-device model."""
+
+    num_heads: int
+    qkv_features: int
+    axis_name: str
+
+    @nn.compact
+    def __call__(self, x):
+        from dynamic_load_balance_distributeddnn_tpu.parallel.ring import (
+            ring_self_attention,
+        )
+
+        h = self.num_heads
+        hd = self.qkv_features // h
+        dense = functools.partial(nn.DenseGeneral, features=(h, hd), axis=-1)
+        q = dense(name="query")(x)  # [B, T_local, H, hd]
+        k = dense(name="key")(x)
+        v = dense(name="value")(x)
+        o = ring_self_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            axis_name=self.axis_name,
+            causal=True,
+        ).transpose(0, 2, 1, 3)
+        return nn.DenseGeneral(
+            features=self.qkv_features, axis=(-2, -1), name="out"
+        )(o)
+
+
 class FlashSelfAttention(nn.Module):
     """Causal multi-head self-attention over the Pallas flash kernel
     (ops/pallas/flash_attention.py): O(T) memory, MXU-tiled matmuls — the
@@ -74,17 +112,26 @@ class EncoderLayer(nn.Module):
     d_ff: int
     dropout: float
     use_flash: bool = False
+    seq_axis: str = ""  # non-empty: ring attention over this sharded axis
 
     @nn.compact
     def __call__(self, x, mask, train: bool):
-        if self.use_flash:
-            attn = FlashSelfAttention(self.nhead, self.d_model)(x)
+        # all three variants share the scope name "attn" and the same
+        # query/key/value/out param layout, so weights are interchangeable
+        # across single-device, flash and sequence-parallel modes
+        if self.seq_axis:
+            attn = RingSelfAttention(
+                self.nhead, self.d_model, self.seq_axis, name="attn"
+            )(x)
+        elif self.use_flash:
+            attn = FlashSelfAttention(self.nhead, self.d_model, name="attn")(x)
         else:
             attn = nn.MultiHeadDotProductAttention(
                 num_heads=self.nhead,
                 qkv_features=self.d_model,
                 dropout_rate=self.dropout,
                 deterministic=not train,
+                name="attn",
             )(x, x, mask=mask)
         attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
         x = nn.LayerNorm()(x + attn)
@@ -106,6 +153,10 @@ class TransformerLM(nn.Module):
     dropout: float = 0.2
     max_len: int = 5000
     use_flash: bool = False  # route attention through the Pallas flash kernel
+    seq_axis: str = ""  # non-empty: sequence-parallel mode — tokens arrive as
+                        # the local shard of a T-sharded global sequence (call
+                        # inside shard_map); attention rings over this axis and
+                        # positions are offset by the shard index
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -119,15 +170,38 @@ class TransformerLM(nn.Module):
 
         x = nn.Embed(self.ntoken, self.ninp, embedding_init=embed_init)(tokens)
         x = x * jnp.sqrt(float(self.ninp))
-        # trace-time constant; folded by XLA, never a trainable parameter
-        pe = jnp.asarray(sinusoidal_positions(min(self.max_len, max(t, 1)), self.ninp))
-        x = x + pe[None, :t, :]
+        if self.seq_axis:
+            # sequence-parallel: this shard holds global positions
+            # [idx*t, (idx+1)*t) — offset the positional encoding accordingly
+            n_shards = jax.lax.axis_size(self.seq_axis)
+            pe = jnp.asarray(
+                sinusoidal_positions(min(self.max_len, n_shards * t), self.ninp)
+            )
+            off = jax.lax.axis_index(self.seq_axis) * t
+            x = x + jax.lax.dynamic_slice(
+                pe, (off, 0), (t, self.ninp)
+            )[None, :, :]
+        else:
+            # trace-time constant; folded by XLA, never a trainable parameter
+            pe = jnp.asarray(
+                sinusoidal_positions(min(self.max_len, max(t, 1)), self.ninp)
+            )
+            x = x + pe[None, :t, :]
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
-        causal = None if self.use_flash else nn.make_causal_mask(tokens)
+        causal = (
+            None
+            if (self.use_flash or self.seq_axis)
+            else nn.make_causal_mask(tokens)
+        )
         for _ in range(self.nlayers):
             x = EncoderLayer(
-                self.ninp, self.nhead, self.nhid, self.dropout, self.use_flash
+                self.ninp,
+                self.nhead,
+                self.nhid,
+                self.dropout,
+                self.use_flash,
+                self.seq_axis,
             )(x, causal, train)
         # Raw logits; the loss layer applies softmax cross-entropy, which on
         # logits equals the reference's NLLLoss-on-log_softmax composition

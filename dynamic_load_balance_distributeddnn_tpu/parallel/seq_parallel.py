@@ -1,0 +1,89 @@
+"""Sequence/context parallelism: train the Transformer LM on sequences
+sharded across the mesh.
+
+The reference's only sequence handling is bptt=35 truncation (SURVEY §5.7);
+this module is the long-context capability built TPU-first. Tokens are
+sharded on the time axis over the mesh; each device embeds its local slice
+(positions offset by shard index), attention runs as the ppermute ring
+(parallel/ring.py — compute on the resident KV block overlaps the transfer
+of the next), and gradients psum across shards. The model is
+``TransformerLM(seq_axis=...)`` — parameter-compatible with the
+single-device model, so checkpoints move freely between modes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS
+
+
+def make_seq_parallel_apply(
+    mesh: Mesh, model, axis_name: str = DATA_AXIS
+) -> Callable:
+    """jit-ready ``(params, tokens [B, T_global]) -> logits [B, T_global, V]``
+    with T sharded over ``axis_name``. ``model`` must be built with
+    ``seq_axis=axis_name``."""
+
+    def local_apply(params, tokens):
+        return model.apply(params, tokens, train=False)
+
+    fn = jax.shard_map(
+        local_apply,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def make_seq_parallel_value_and_grad(
+    mesh: Mesh, model, axis_name: str = DATA_AXIS
+) -> Callable:
+    """jit-ready ``(params, tokens, targets) -> (mean_xent, grads)`` over a
+    T-sharded global sequence; loss and grads are psum-combined so every
+    shard (and the caller) sees the global values."""
+
+    def local_loss(params, tokens, targets):
+        logits = model.apply(params, tokens, train=False)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1
+        )[..., 0]
+        local_sum = jnp.sum(logz - gold)
+        local_cnt = jnp.asarray(targets.size, jnp.float32)
+        total = jax.lax.psum(jnp.stack([local_sum, local_cnt]), axis_name)
+        return total[0] / total[1]
+
+    # Differentiate THROUGH shard_map: its transpose rules account for the
+    # replicated params (sum of per-shard cotangents inserted exactly once)
+    # and for the ring's ppermute flows. Differentiating inside the shard
+    # program instead double-counts whatever traveled through collectives.
+    sharded_loss = jax.shard_map(
+        local_loss,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(jax.value_and_grad(sharded_loss))
+
+
+def shard_tokens(mesh: Mesh, tokens, axis_name: str = DATA_AXIS):
+    """Place a [B, T_global] token array with T sharded over the mesh."""
+    from jax.sharding import NamedSharding
+
+    return jax.device_put(tokens, NamedSharding(mesh, P(None, axis_name)))
+
+
+__all__ = [
+    "make_seq_parallel_apply",
+    "make_seq_parallel_value_and_grad",
+    "shard_tokens",
+]

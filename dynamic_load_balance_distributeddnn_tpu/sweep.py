@@ -22,6 +22,8 @@ def main(argv=None) -> int:
     p.add_argument("-lr", "--learning_rate", type=float, default=0.01)
     p.add_argument("-dev", "--device", type=str, default="0")
     p.add_argument("-de", "--disable_enhancements", type=str, default="false")
+    p.add_argument("-d", "--debug", type=str, default="false",
+                   help="pass debug mode through to every leg (smoke runs)")
     p.add_argument("--models", type=str, default="resnet,densenet,googlenet,regnet")
     p.add_argument("--datasets", type=str, default="cifar10,cifar100")
     ns = p.parse_args(argv)
@@ -33,7 +35,7 @@ def main(argv=None) -> int:
     )
     for dbs, dataset, model in grid:
         args = [
-            "-d", "false",
+            "-d", ns.debug,
             "-ws", str(ns.world_size),
             "-b", str(ns.batch_size),
             "-e", str(ns.epoch_size),

@@ -20,8 +20,7 @@ invariant again, now in token space.
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -40,7 +39,7 @@ from dynamic_load_balance_distributeddnn_tpu.models import build_model
 from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import replicated_sharding
 from dynamic_load_balance_distributeddnn_tpu.train.engine import Trainer
 from dynamic_load_balance_distributeddnn_tpu.train.state import create_state, make_optimizer
-from dynamic_load_balance_distributeddnn_tpu.train.steps import StepLibrary, shard_views
+from dynamic_load_balance_distributeddnn_tpu.train.steps import StepLibrary
 
 
 class LMTrainer(Trainer):
@@ -166,7 +165,12 @@ class LMTrainer(Trainer):
 
     # ------------------------------------------------------------- validate
 
-    def validate(self, batch: int = 0) -> Tuple[float, float]:
+    def validate(self) -> Tuple[float, float]:
+        """bptt-windowed NLL over the test stream, sharded over the mesh: the
+        [windows, bsz, bptt] windows flatten to independent [rows, bptt]
+        sequences (each row is one column's window — the model treats batch
+        rows independently) and run through the same fused sharded eval as
+        the vision path, in fixed-shape chunks."""
         cfg = self.cfg
         eval_bsz = 10  # dataloader.py:109
         stream = self.corpus.test
@@ -174,20 +178,11 @@ class LMTrainer(Trainer):
             stream = stream[:20_000]
         data = batchify(stream, eval_bsz)
         x, y, m = bptt_windows(data, cfg.bptt)
-        views = shard_views(self.state.params, self.topology.devices)
-        dev = self.topology.devices[0]
-        loss_sum = count = 0.0
-        import jax
-
-        for s in range(x.shape[0]):
-            ls, _, ct = self.steps.eval_step(
-                views[0],
-                jax.device_put(x[s], dev),
-                jax.device_put(y[s], dev),
-                jax.device_put(m[s], dev),
-            )
-            loss_sum += float(ls)
-            count += float(ct)
+        loss_sum, _, count = self._eval_sharded(
+            x.reshape(-1, cfg.bptt),
+            y.reshape(-1, cfg.bptt),
+            mask=m.reshape(-1, cfg.bptt),
+        )
         val_loss = loss_sum / max(count, 1.0)
         # "accuracy" = 1 - val_loss: the reference's LM convention
         # (dbs.py:180-181), not a real accuracy.

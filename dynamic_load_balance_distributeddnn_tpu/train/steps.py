@@ -195,26 +195,20 @@ class StepLibrary:
 
         self.combine_probe = combine_probe
 
-        # ------------------------------------------------------------- eval
-
-        @jax.jit
-        def eval_step(params, x, y, mask):
-            xf = self._prep_images(x, jax.random.PRNGKey(0), train=False)
-            out = apply_fn(params, xf, train=False)
-            losses = _per_example_loss(spec, out, y)
-            m = mask.astype(jnp.float32)
-            pred = jnp.argmax(out, axis=-1)
-            correct = jnp.sum((pred == y).astype(jnp.float32) * m)
-            return jnp.sum(losses * m), correct, jnp.sum(m)
-
-        self.eval_step = eval_step
-
     # ------------------------------------------------------------ fused path
+    # (evaluation is always the sharded fused_eval_step — there is no
+    # single-device eval path)
 
-    def _fused_shard_body(self, state, x, y, w, slow_scalar, seed):
+    def _fused_shard_body(self, state, x, y, w, slow_scalar, seed, with_comm=True):
         """Per-device body of the fused SPMD step: local grad, optional
         per-worker clip (reference clips before combining, dbs.py:274), psum,
-        replicated SGD update."""
+        replicated SGD update.
+
+        ``with_comm=False`` builds the comm-free twin used by the sync-time
+        probe (engine._probe_fused_sync): identical math except the psums are
+        skipped, so (t_full − t_nocomm) isolates the collective cost — the
+        fused-path analogue of the reference's per-step allreduce wait meter
+        (dbs.py:297-299)."""
         spec = self.spec
         apply_fn = spec.module.apply
         tx = self.tx
@@ -242,8 +236,10 @@ class StepLibrary:
             grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
 
         probe = synthetic_load(slow_scalar, wloss)
-        grads = jax.lax.psum(grads, DATA_AXIS)
-        metrics = jax.lax.psum(jnp.stack([wloss, loss_sum, count, probe]), DATA_AXIS)
+        metrics = jnp.stack([wloss, loss_sum, count, probe])
+        if with_comm:
+            grads = jax.lax.psum(grads, DATA_AXIS)
+            metrics = jax.lax.psum(metrics, DATA_AXIS)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
@@ -298,6 +294,53 @@ class StepLibrary:
             check_vma=False,
         )
         return jax.jit(sharded, donate_argnums=(0,))
+
+    def _fused_probe(self, with_comm: bool):
+        """Non-donating single-step twin of ``fused_step`` for timing probes.
+        ``with_comm=False`` drops the psums (see _fused_shard_body); outputs
+        are discarded by the caller, so the unreplicated no-comm outputs are
+        harmless (check_vma is off)."""
+
+        def per_shard(state, x, y, w, slow_iters, seed):
+            return self._fused_shard_body(
+                state, x, y, w, slow_iters[0], seed, with_comm=with_comm
+            )
+
+        sharded = jax.shard_map(
+            per_shard,
+            mesh=self.mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    @functools.cached_property
+    def fused_step_probe(self):
+        return self._fused_probe(with_comm=True)
+
+    @functools.cached_property
+    def fused_step_nocomm(self):
+        return self._fused_probe(with_comm=False)
+
+    @functools.cached_property
+    def comm_probe(self):
+        """Standalone gradient collective: psum of a grads-shaped tree over
+        the mesh. Fallback sync-time meter when the full-vs-nocomm delta is
+        below timer noise — the closest analogue of the reference's blocking
+        allreduce wait (dbs.py:296-298)."""
+
+        def per_shard(tree):
+            return jax.lax.psum(tree, DATA_AXIS)
+
+        sharded = jax.shard_map(
+            per_shard,
+            mesh=self.mesh,
+            in_specs=(P(),),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
 
     @functools.cached_property
     def fused_eval_step(self):

@@ -1,0 +1,88 @@
+"""Checkpoint/resume tests (train/checkpoint.py — the deliberate capability
+upgrade over the reference, which has no model checkpointing at all,
+SURVEY §5.4): orbax save -> restore -> resume, with the DBS controller state
+(shares, node_times, wallclock) preserved so a resumed run continues balanced.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # orbax save/restore + multi-epoch runs
+
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
+from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+
+def cfg(tmp_path, **kw):
+    base = dict(
+        debug=True,
+        world_size=2,
+        batch_size=64,
+        learning_rate=0.05,
+        epoch_size=3,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=True,
+        seed=99,
+        bucket=8,
+        stat_dir=str(tmp_path / "statis"),
+        ckpt_dir=str(tmp_path / "ckpt"),
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return synthetic_dataset("mnist", n_train=256, n_test=64)
+
+
+def linear_time(plan):
+    return np.array([w.padded_batch * w.steps * 1e-3 for w in plan.workers])
+
+
+def leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def test_save_restore_roundtrip_preserves_state(bundle, tmp_path):
+    tr = Trainer(cfg(tmp_path), bundle=bundle, log_to_file=False,
+                 timing_model=linear_time,
+                 injector=StaticStragglerInjector([2.0, 1.0], mode="virtual"))
+    tr.run(epochs=2)  # saves a checkpoint per epoch (ckpt_dir set)
+
+    # a fresh trainer restores epoch, params, and controller state
+    tr2 = Trainer(cfg(tmp_path), bundle=bundle, log_to_file=False,
+                  timing_model=linear_time)
+    start = tr2._maybe_restore()
+    assert start == 2  # resumes AFTER the last saved epoch
+    for a, b in zip(leaves(tr.state.params), leaves(tr2.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    np.testing.assert_allclose(tr2.shares, tr.shares)
+    np.testing.assert_allclose(tr2.node_times, tr.node_times)
+    assert tr2.total_wallclock == pytest.approx(tr.total_wallclock)
+    # balance survived: the straggled worker's share is below uniform
+    assert tr2.shares[0] < 0.5
+
+
+def test_resume_continues_not_restarts(bundle, tmp_path):
+    c = cfg(tmp_path, epoch_size=3)
+    tr = Trainer(c, bundle=bundle, log_to_file=False, timing_model=linear_time)
+    tr.run(epochs=2)
+    step_after_2 = int(tr.state.step)
+
+    tr2 = Trainer(c, bundle=bundle, log_to_file=False, timing_model=linear_time)
+    rec = tr2.run(epochs=3)  # restores epochs 0-1, trains only epoch 2
+    assert len(rec.data["epoch"]) == 1
+    assert rec.data["epoch"][0] == 2
+    assert int(tr2.state.step) > step_after_2  # optimizer kept stepping
+
+
+def test_restore_absent_dir_is_noop(bundle, tmp_path):
+    c = cfg(tmp_path, ckpt_dir=str(tmp_path / "nope"))
+    tr = Trainer(c, bundle=bundle, log_to_file=False, timing_model=linear_time)
+    assert tr._maybe_restore() == 0

@@ -1,0 +1,72 @@
+"""Entry-point smoke tests: cli.main (dbs.py:527-544 analogue) and the sweep
+harness (run.sh:25-50 analogue) driven end-to-end on tiny synthetic data.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu import cli, sweep
+
+pytestmark = pytest.mark.slow  # full debug-mode runs through the entry points
+
+
+def cli_args(tmp_path, **over):
+    args = {
+        "-d": "true",
+        "-ws": "2",
+        "-b": "64",
+        "-e": "1",
+        "-lr": "0.05",
+        "-m": "mnistnet",
+        "-ds": "mnist",
+        "-dbs": "true",
+        "--data_dir": str(tmp_path / "data"),
+        "--log_dir": str(tmp_path / "logs"),
+        "--stat_dir": str(tmp_path / "statis"),
+    }
+    args.update(over)
+    return [t for kv in args.items() for t in kv]
+
+
+def test_cli_runs_and_writes_artifacts(tmp_path):
+    rc = cli.main(cli_args(tmp_path))
+    assert rc == 0
+    stats = os.listdir(tmp_path / "statis")
+    npys = [f for f in stats if f.endswith(".npy")]
+    jsons = [f for f in stats if f.endswith(".json")]
+    assert len(npys) == 1 and len(jsons) == 1
+    # the config-encoded filename carries the reference's fields (dbs.py:54-61)
+    assert "mnistnet-mnist" in npys[0] and "-dbs1-" in npys[0]
+    with open(tmp_path / "statis" / jsons[0]) as f:
+        series = json.load(f)
+    for k in ("epoch", "train_loss", "partition", "node_time", "wallclock_time"):
+        assert len(series[k]) == 1, k
+    assert np.isfinite(series["train_loss"]).all()
+
+
+def test_cli_idempotence_skip(tmp_path, capsys):
+    args = cli_args(tmp_path)
+    assert cli.main(args) == 0
+    before = sorted(os.listdir(tmp_path / "statis"))
+    assert cli.main(args) == 0  # second run: sentinel -> skip
+    assert "skipping" in capsys.readouterr().out
+    assert sorted(os.listdir(tmp_path / "statis")) == before
+
+
+def test_sweep_runs_grid_and_is_idempotent(tmp_path, monkeypatch):
+    """One-leg grid through the real sweep entry point; the second invocation
+    must skip every completed leg via the sentinel (run.sh + dbs.py:528-534)."""
+    monkeypatch.chdir(tmp_path)  # sweep legs use default ./logs, ./statis, ./data
+    argv = [
+        "-ws", "2", "-b", "64", "-e", "1", "-d", "true",
+        "--models", "mnistnet", "--datasets", "mnist",
+        "-dev", "0,1",
+    ]
+    assert sweep.main(argv) == 0
+    stats = sorted(os.listdir(tmp_path / "statis"))
+    assert len([f for f in stats if f.endswith(".npy")]) == 2  # dbs on + off
+    assert sweep.main(argv) == 0  # all legs skipped, still rc 0
+    assert sorted(os.listdir(tmp_path / "statis")) == stats

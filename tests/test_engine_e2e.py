@@ -67,6 +67,7 @@ def test_e2e_uniform_runs_and_learns(bundle, tmp_path):
     assert np.allclose(rec.data["partition"][-1], 0.25, atol=0.12)
 
 
+@pytest.mark.slow
 def test_e2e_partition_shifts_under_straggler(bundle, tmp_path):
     """The DBS capability itself: a 3:1 virtual straggler on worker 0 must
     pull worker 0's share below uniform and push the others above."""
@@ -91,6 +92,7 @@ def test_e2e_partition_shifts_under_straggler(bundle, tmp_path):
     assert nt.max() / nt.min() < 2.0
 
 
+@pytest.mark.slow
 def test_e2e_fused_path_dbs_off(bundle, tmp_path):
     """dbs-off with one worker per device takes the fused whole-epoch SPMD
     scan path; results must be sane."""
@@ -106,6 +108,7 @@ def test_e2e_fused_path_dbs_off(bundle, tmp_path):
     assert rec.data["train_loss"][-1] < rec.data["train_loss"][0] * 1.2
 
 
+@pytest.mark.slow
 def test_e2e_dbs_off_stays_uniform(bundle, tmp_path):
     tr = make_trainer(
         bundle,
@@ -118,6 +121,7 @@ def test_e2e_dbs_off_stays_uniform(bundle, tmp_path):
     assert np.allclose(rec.data["partition"][-1], 0.25)
 
 
+@pytest.mark.slow
 def test_e2e_contention_map(bundle, tmp_path):
     """The README recipe shape: several workers share one device
     (analogue of -gpu 0,0,0,1)."""
@@ -133,6 +137,7 @@ def test_e2e_contention_map(bundle, tmp_path):
     assert tr.topology.contention_factor(3) == 1
 
 
+@pytest.mark.slow
 def test_e2e_disable_enhancements(bundle, tmp_path):
     """-de: uniform 1/ws gradient weights (dbs.py:293) still trains."""
     tr = make_trainer(
@@ -142,6 +147,7 @@ def test_e2e_disable_enhancements(bundle, tmp_path):
     assert np.isfinite(rec.data["train_loss"]).all()
 
 
+@pytest.mark.slow
 def test_compute_injection_applies_without_dbs(bundle, tmp_path):
     """The dbs-off A/B arm must still receive compute-mode straggler load
     (probes run for calibration even with the balancer off)."""
@@ -190,6 +196,7 @@ def test_recorder_has_nine_series(bundle, tmp_path):
         assert len(rec.data[k]) == 1, k
 
 
+@pytest.mark.slow
 def test_e2e_eight_workers_heterogeneous_map(bundle, tmp_path):
     """BASELINE.md acceptance config 4: 8 workers on a heterogeneous device
     map (two workers contend on device 0, the rest own a chip each). The

@@ -1,0 +1,120 @@
+"""Unit tests for the straggler-injection state machines (faults.py).
+
+The reference's episode semantics (dbs.py:94-129): each epoch a non-waiting
+worker rolls luck against ``ftc``; on a hit it commits to U[5,10] extra
+seconds per epoch for U[4,20] consecutive epochs, and does not re-roll while
+the episode runs.
+"""
+
+import numpy as np
+
+from dynamic_load_balance_distributeddnn_tpu.faults import (
+    EpochFaults,
+    FaultContext,
+    LuckyFaultInjector,
+    NullInjector,
+    StaticStragglerInjector,
+)
+
+
+def ctx(ws: int, iter_cost: float | None = None) -> FaultContext:
+    return FaultContext(
+        batch_sizes=np.full(ws, 32.0),
+        iter_cost_s=iter_cost,
+        per_example_cost_s=np.full(ws, 1e-3) if iter_cost else None,
+    )
+
+
+def run_episodes(injector, ws, epochs=400):
+    """Drive the injector and return the per-epoch virtual_seconds matrix."""
+    return np.stack(
+        [injector.epoch_faults(e, 10, ctx(ws)).virtual_seconds for e in range(epochs)]
+    )
+
+
+def test_lucky_injector_episode_semantics():
+    ws = 4
+    inj = LuckyFaultInjector(ws, chance=0.1, seed=7)
+    secs = run_episodes(inj, ws)
+    assert secs.shape == (400, ws)
+    # with chance 0.1 over 400 epochs, every worker hits at least once
+    assert (secs.sum(axis=0) > 0).all()
+    for r in range(ws):
+        col = secs[:, r]
+        # decompose into episodes: maximal runs of identical nonzero values
+        e = 0
+        episodes = []
+        while e < len(col):
+            if col[e] > 0:
+                start, val = e, col[e]
+                while e < len(col) and col[e] == val:
+                    e += 1
+                episodes.append((start, e - start, val))
+            else:
+                e += 1
+        assert episodes, f"worker {r} never became a straggler"
+        for start, length, val in episodes:
+            # wait seconds drawn U[5,10] (dbs.py:120)
+            assert 5 <= val <= 10
+            # episode duration U[4,20] epochs (dbs.py:122) — inclusive
+            # bookkeeping makes the observable run length span+1; back-to-back
+            # episodes with equal wait values can also merge two draws
+            if start + length < len(col):  # complete episode (not truncated)
+                assert length >= 4
+
+
+def test_lucky_injector_no_reroll_mid_episode():
+    """While an episode runs, the worker must not re-roll (the reference's
+    waiting guard, dbs.py:99): wait seconds stay constant for >= 4 epochs."""
+    inj = LuckyFaultInjector(1, chance=1.0, seed=3)  # hit immediately
+    secs = run_episodes(inj, 1, epochs=5)[:, 0]
+    assert secs[0] > 0
+    assert (secs[:4] == secs[0]).all()
+
+
+def test_lucky_injector_deterministic_with_seed():
+    a = run_episodes(LuckyFaultInjector(4, 0.2, seed=11), 4, epochs=60)
+    b = run_episodes(LuckyFaultInjector(4, 0.2, seed=11), 4, epochs=60)
+    assert (a == b).all()
+    c = run_episodes(LuckyFaultInjector(4, 0.2, seed=12), 4, epochs=60)
+    assert (a != c).any()
+
+
+def test_lucky_injector_zero_chance_never_fires():
+    inj = LuckyFaultInjector(4, chance=0.0, seed=0)
+    assert run_episodes(inj, 4, epochs=50).sum() == 0
+
+
+def test_lucky_injector_compute_mode_converts_to_iters():
+    """compute mode: seconds/epoch are spread over the epoch's steps and
+    converted to synthetic-load iterations via the calibrated rate."""
+    inj = LuckyFaultInjector(2, chance=1.0, mode="compute", seed=5)
+    out = inj.epoch_faults(0, num_batches=10, ctx=ctx(2, iter_cost=1e-3))
+    assert (out.virtual_seconds == 0).all()
+    assert (out.slow_iters_per_step > 0).all()
+    # ~ secs / steps / iter_cost: 5..10s over 10 steps at 1ms/iter = 500..1000
+    assert (out.slow_iters_per_step >= 500).all()
+    assert (out.slow_iters_per_step <= 1000).all()
+
+
+def test_static_injector_virtual_multipliers():
+    inj = StaticStragglerInjector([3.0, 1.0], mode="virtual")
+    out = inj.epoch_faults(0, 10, ctx(2))
+    assert out.time_multipliers.tolist() == [3.0, 1.0]
+    assert out.virtual_seconds.sum() == 0
+
+
+def test_static_injector_compute_mode_scales_with_batch():
+    inj = StaticStragglerInjector([3.0, 1.0], mode="compute")
+    c = ctx(2, iter_cost=1e-4)
+    out = inj.epoch_faults(1, 10, c)
+    # worker 0: (3-1) * 1e-3 s/ex * 32 ex / 1e-4 s/iter = 640 iters
+    assert out.slow_iters_per_step[0] == 640
+    assert out.slow_iters_per_step[1] == 0
+
+
+def test_null_injector():
+    out = NullInjector(3).epoch_faults(0, 10, ctx(3))
+    assert isinstance(out, EpochFaults)
+    assert out.virtual_seconds.sum() == 0
+    assert (out.time_multipliers == 1.0).all()

@@ -8,6 +8,8 @@ from dynamic_load_balance_distributeddnn_tpu.data.corpus import Corpus
 from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
 from dynamic_load_balance_distributeddnn_tpu.train.lm_engine import LMTrainer
 
+pytestmark = pytest.mark.slow  # multi-epoch LM e2e with 200-dim transformer
+
 
 @pytest.fixture(scope="module")
 def tiny_corpus(tmp_path_factory):

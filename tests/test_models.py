@@ -30,6 +30,7 @@ def test_mnistnet_shapes():
 # instantiating the reference models directly). GoogLeNet has no reference
 # count — the original crashes at forward (Net/GoogleNet.py:29-30 defect) —
 # so its fixed version is range-checked.
+@pytest.mark.slow  # full-size model init + forward, ~20-40s each
 @pytest.mark.parametrize(
     "name,nc,expect",
     [
@@ -45,6 +46,7 @@ def test_cnn_families_exact_param_parity(name, nc, expect):
     assert n == expect, f"{name}: {n:,} params != reference {expect:,}"
 
 
+@pytest.mark.slow
 def test_googlenet_fixed_runs():
     spec = build_model("googlenet", num_classes=10)
     out, n = _init_and_apply(spec, jnp.zeros((2, 32, 32, 3)))
@@ -52,6 +54,7 @@ def test_googlenet_fixed_runs():
     assert 5.5e6 < n < 7.0e6
 
 
+@pytest.mark.slow
 def test_resnet18_small_variant():
     from dynamic_load_balance_distributeddnn_tpu.models.resnet import ResNet18
 
@@ -60,6 +63,7 @@ def test_resnet18_small_variant():
     n = sum(p.size for p in jax.tree_util.tree_leaves(params))
     assert n == 11_173_962  # exact torch parity
 
+@pytest.mark.slow
 def test_outputs_finite_on_random_input():
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(2, 32, 32, 3), jnp.float32)

@@ -20,6 +20,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # ~170s: real 2-process rendezvous + training
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_mh_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -101,6 +101,7 @@ def test_pallas_groupnorm_module_swaps_in():
     assert isinstance(group_norm(32), nn.GroupNorm)
 
 
+@pytest.mark.slow  # ~56s: two DenseNet inits
 def test_pallas_toggle_param_trees_identical():
     """The toggle must be compute-only: same module names, same param pytree,
     so checkpoints are portable across --use_pallas."""

@@ -1,0 +1,43 @@
+"""Unit tests for the one-cycle LR schedule (reference dbs.py:193-215).
+
+The live branch of the reference is the final-30% linear decay; this
+implementation fixes the reference's discontinuity typo (dbs.py:210, uses
+``epoch`` where ``epoch_size`` was meant), so the curve here is: constant
+``base_lr`` for the first 70% of epochs, then a straight line down to
+``0.01 * base_lr`` at the final epoch boundary.
+"""
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.train.schedule import one_cycle_lr
+
+
+def test_constant_before_decay_start():
+    for e in range(7):
+        assert one_cycle_lr(0.1, e, 10) == pytest.approx(0.1)
+
+
+def test_linear_decay_tail():
+    base, E = 0.1, 10
+    lrs = [one_cycle_lr(base, e, E) for e in range(7, 10)]
+    # strictly decreasing, evenly spaced (linear)
+    diffs = np.diff(lrs)
+    assert (diffs < 0).all()
+    assert np.allclose(diffs, diffs[0])
+    # decay reaches 0.01x at the end of training (epoch == epoch_size)
+    assert one_cycle_lr(base, E, E) == pytest.approx(0.01 * base)
+
+
+def test_decay_is_continuous_at_start():
+    """The reference's typo made the decay jump discontinuously at the 70%
+    boundary; the fixed curve starts the decay exactly at base_lr."""
+    base, E = 0.1, 100
+    assert one_cycle_lr(base, 70, E) == pytest.approx(base)
+    assert one_cycle_lr(base, 71, E) < base
+
+
+def test_disabled_flags_return_base():
+    # -ocp false (dbs.py:386) and -de true (dbs.py:202-203) both bypass
+    assert one_cycle_lr(0.1, 9, 10, enabled=False) == 0.1
+    assert one_cycle_lr(0.1, 9, 10, disable_enhancements=True) == 0.1

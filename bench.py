@@ -1,28 +1,43 @@
 #!/usr/bin/env python
 """Headline benchmark — prints ONE JSON line.
 
-The north-star scenario (BASELINE.json / README.md:23-28): DenseNet-121 on
-CIFAR-10, world_size=4, global batch 512, under an induced 3:1 straggler on
-worker 0, DBS on vs off (A/B, as run.sh does). The straggler is delivered as
-real on-device compute (fault_mode='compute'), so epoch wall-clock genuinely
-moves; both arms run the same elastic execution path, so the comparison
-isolates the balancer.
+North-star scenario (BASELINE.json / reference README.md:23-28): DenseNet-121
+on CIFAR-10, world_size=4, global batch 512, induced 3:1 straggler on worker 0
+(real on-device compute, fault_mode='compute'), DBS on vs off (the A/B of
+run.sh:25-41). Metric: steady-state epoch wall-clock with DBS on;
+vs_baseline: speedup over the DBS-off arm (>1 = the balancer wins).
 
-Each arm runs in its own subprocess with retries: a TPU runtime/tunnel crash
-(observed sporadically on this host) kills only that attempt, not the
-benchmark.
+Resilience design (from measured behavior of this host's TPU tunnel: backend
+init can block 50+ minutes and then fail UNAVAILABLE):
 
-Metric: steady-state epoch wall-clock with DBS on (seconds; lower is better).
-vs_baseline: speedup over the DBS-off arm (>1 means DBS wins).
+1. PREFLIGHT LADDER — a standalone subprocess inits the backend with
+   escalating timeouts (BENCH_PREFLIGHT_TIMEOUTS, default 600,1500,2400s),
+   retrying until the reserve deadline. Arms never burn attempts on a wedged
+   runtime.
+2. CPU INSURANCE — after the first failed preflight, a small CPU-mesh A/B
+   (same code path, virtual 4-device mesh, compute-mode straggler) runs so a
+   clearly-labeled fallback number exists; preflight then continues, and a
+   real TPU result overwrites the insurance.
+3. ONE INIT FOR BOTH ARMS — both arms run in a single subprocess (one
+   backend claim), writing per-epoch walls incrementally; a crash mid-run
+   leaves salvageable partials. Retries shrink BENCH_NTRAIN (compile cache
+   persists across attempts via JAX_COMPILATION_CACHE_DIR).
+4. EARLY EXIT — SIGTERM/SIGINT print the best result so far before dying, so
+   a driver-side kill still yields a parsed line.
 
-Environment knobs: BENCH_NTRAIN (default 12800), BENCH_EPOCHS (default 5),
-BENCH_WS (default 4), BENCH_RETRIES (default 4), BENCH_ARM_TIMEOUT (seconds
-per arm attempt, default 5400), BENCH_INIT_TIMEOUT (seconds for TPU backend
-init before the arm aborts, default 300).
+Instrumentation: examples/s and MFU (obs/flops.py, XLA cost model vs chip
+bf16 peak) from the trainer's recorder extras, reported in `detail`.
+
+Knobs: BENCH_NTRAIN (12800), BENCH_EPOCHS (5), BENCH_WS (4), BENCH_RETRIES
+(3), BENCH_TOTAL_BUDGET (5400s), BENCH_ARM_RESERVE (1800s),
+BENCH_INIT_TIMEOUT (2700s, in-subprocess init watchdog),
+BENCH_PREFLIGHT_TIMEOUTS, BENCH_FORCE_CPU=1 (skip TPU entirely),
+BENCH_CPU_INSURANCE=0 (disable the fallback).
 """
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -30,149 +45,321 @@ import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "./.jax_cache")
 
+_best_result = None  # orchestrator's best-known JSON dict
 
-def run_arm(dbs_on: bool, n_epochs: int, out_path: str) -> None:
-    """Subprocess entry: run one A/B arm and dump per-epoch walls to JSON."""
-    # Fail fast if the TPU runtime/tunnel is wedged: backend init has been
-    # observed to hang indefinitely after a TPU worker crash. A hung init
-    # should cost one retry (with backoff), not the whole arm timeout. The
-    # hang is inside PJRT C++ code, where Python signal handlers never run —
-    # so the watchdog is a daemon thread that hard-exits the process.
+
+# --------------------------------------------------------------- subprocesses
+
+
+def _install_init_watchdog():
+    """Hard-exit if backend init blocks past BENCH_INIT_TIMEOUT. The hang is
+    inside PJRT C++ where Python signal handlers never run, so a daemon
+    thread + os._exit is the only reliable abort."""
     import threading
 
-    init_done = threading.Event()
+    done = threading.Event()
 
     def _watchdog():
-        if not init_done.wait(int(os.environ.get("BENCH_INIT_TIMEOUT", 300))):
-            sys.stderr.write("[bench] TPU backend init timed out; aborting arm\n")
+        if not done.wait(int(os.environ.get("BENCH_INIT_TIMEOUT", 2700))):
+            sys.stderr.write("[bench] backend init timed out; aborting\n")
             sys.stderr.flush()
             os._exit(17)
 
     threading.Thread(target=_watchdog, daemon=True).start()
+    return done
+
+
+def run_preflight() -> int:
+    """Init the backend, run one tiny matmul, report device info. rc 0 = the
+    TPU is usable; rc 17 = init watchdog fired; other rc = init raised."""
+    done = _install_init_watchdog()
+    t0 = time.time()
+    import jax
+
+    try:
+        ds = jax.devices()
+    except Exception as e:
+        sys.stderr.write(f"[preflight] init raised after {time.time()-t0:.0f}s: {e}\n")
+        return 3
+    done.set()
+    import jax.numpy as jnp
+
+    y = (jnp.ones((256, 256), jnp.bfloat16) @ jnp.ones((256, 256), jnp.bfloat16))
+    jax.block_until_ready(y)
+    info = {
+        "platform": ds[0].platform,
+        "device_kind": getattr(ds[0], "device_kind", "?"),
+        "n_devices": len(ds),
+        "init_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(info), flush=True)
+    return 0
+
+
+def _write_atomic(path: str, obj) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def run_arms(out_path: str, force_cpu: bool) -> int:
+    """Run the dbs-off then dbs-on arm in THIS process (one backend init),
+    writing per-epoch walls + instrumentation incrementally to out_path."""
+    if force_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # beats the axon plugin
+    done = _install_init_watchdog()
     import jax
 
     jax.devices()
-    init_done.set()
+    done.set()
 
     from dynamic_load_balance_distributeddnn_tpu.config import Config
     from dynamic_load_balance_distributeddnn_tpu.data import load_dataset
     from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
     from dynamic_load_balance_distributeddnn_tpu.train import Trainer
 
-    n_train = int(os.environ.get("BENCH_NTRAIN", 12800))
+    if force_cpu:
+        n_train = int(os.environ.get("BENCH_CPU_NTRAIN", 2048))
+        model, batch, bucket = "mnistnet", 512, 32
+        dataset = "mnist"
+    else:
+        n_train = int(os.environ.get("BENCH_NTRAIN", 12800))
+        model, batch, bucket = "densenet", 512, 32
+        dataset = "cifar10"
+    epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
     ws = int(os.environ.get("BENCH_WS", 4))
-    bundle = load_dataset("cifar10", n_train=n_train, n_test=512)
+    bundle = load_dataset(dataset, n_train=n_train, n_test=512)
     factors = [3.0] + [1.0] * (ws - 1)
 
-    cfg = Config(
-        debug=False,
-        world_size=ws,
-        batch_size=512,
-        learning_rate=0.01,
-        epoch_size=n_epochs,
-        dataset="cifar10",
-        model="densenet",
-        dynamic_batch_size=dbs_on,
-        fault_tolerance=True,
-        fault_mode="compute",
-        bucket=32,
-    )
-    tr = Trainer(
-        cfg,
-        bundle=bundle,
-        injector=StaticStragglerInjector(factors, mode="compute"),
-        log_to_file=False,
-    )
-    walls = [tr.run_epoch(e)["epoch_wall"] for e in range(n_epochs)]
-    with open(out_path, "w") as f:
-        json.dump({"walls": walls}, f)
+    out = {
+        "backend": "cpu_fallback" if force_cpu else "tpu",
+        "n_train": n_train,
+        "model": model,
+        "world_size": ws,
+        "off": [],
+        "on": [],
+        "instr": {},
+    }
+    _write_atomic(out_path, out)
+
+    # epoch 0 calibrates (no injection), epoch 1 is the first injected epoch;
+    # the off arm needs fewer epochs since it never rebalances
+    for arm, dbs_on, n_ep in (("off", False, max(3, epochs - 2)), ("on", True, epochs)):
+        cfg = Config(
+            debug=False,
+            world_size=ws,
+            batch_size=batch,
+            learning_rate=0.01,
+            epoch_size=n_ep,
+            dataset=dataset,
+            model=model,
+            dynamic_batch_size=dbs_on,
+            fault_tolerance=True,
+            fault_mode="compute",
+            bucket=bucket,
+            # pre-compile the bucketed shape ladder so rebalance epochs never
+            # pay an XLA compile inside a timed wall (the balancer's win would
+            # otherwise drown in compile noise on short runs)
+            warm_start=dbs_on,
+        )
+        tr = Trainer(
+            cfg,
+            bundle=bundle,
+            injector=StaticStragglerInjector(factors, mode="compute"),
+            log_to_file=False,
+        )
+        for e in range(n_ep):
+            wall = tr.run_epoch(e)["epoch_wall"]
+            out[arm].append(round(wall, 4))
+            _write_atomic(out_path, out)
+        for k in ("examples_per_s", "mfu_bf16_peak", "accuracy"):
+            if tr.recorder.data.get(k):
+                out["instr"][f"{arm}_{k}"] = tr.recorder.data[k][-1]
+        _write_atomic(out_path, out)
+    return 0
 
 
-def run_arm_with_retries(dbs_on: bool, n_epochs: int, retries: int):
+# --------------------------------------------------------------- orchestrator
+
+
+def _steady(walls_off, walls_on):
+    """Steady-state epoch walls: skip the calibration epoch on the off arm
+    and calibration+first-reaction on the on arm."""
+    import numpy as np
+
+    off = float(np.min(walls_off[1:])) if len(walls_off) >= 2 else None
+    on = float(np.min(walls_on[2:])) if len(walls_on) >= 3 else None
+    return off, on
+
+
+def _result_from(partial) -> dict | None:
+    off, on = _steady(partial.get("off", []), partial.get("on", []))
+    if off is None or on is None or on <= 0:
+        return None
+    detail = {
+        "backend": partial.get("backend"),
+        "model": partial.get("model"),
+        "dbs_off_epochs_s": partial.get("off"),
+        "dbs_on_epochs_s": partial.get("on"),
+        "n_train": partial.get("n_train"),
+        "world_size": partial.get("world_size"),
+        **partial.get("instr", {}),
+    }
+    return {
+        "metric": "densenet121_cifar10_ws4_3to1straggler_epoch_wallclock"
+        if partial.get("backend") == "tpu"
+        else "cpu_fallback_ws4_3to1straggler_epoch_wallclock",
+        "value": round(on, 4),
+        "unit": "s",
+        "vs_baseline": round(off / on, 4),
+        "detail": detail,
+    }
+
+
+def _emit_and_exit(signum=None, frame=None):
+    if _best_result is not None:
+        print(json.dumps(_best_result), flush=True)
+        sys.exit(0)
+    sys.exit(1)
+
+
+def _run_child(args, timeout):
+    try:
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+
+
+def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
+    """Run the arms subprocess with retries; returns a result dict (possibly
+    from salvaged partials) or None."""
+    best = None
+    best_quality = (-1, -1)  # (epochs salvaged, n_train) — bigger is better
+    n_train = int(os.environ.get("BENCH_NTRAIN", 12800))
     for attempt in range(retries):
-        with tempfile.NamedTemporaryFile(
-            mode="r", suffix=".json", delete=False
-        ) as tf:
-            out_path = tf.name
+        budget = deadline - time.time()
+        if budget < 120:
+            break
+        fd, out_path = tempfile.mkstemp(suffix=".json")
+        os.close(fd)
+        env_n = str(max(n_train // (2 ** attempt), 2560))  # salvage: shrink
+        os.environ["BENCH_NTRAIN"] = env_n
+        args = ["--arms", "--out", out_path] + (["--cpu"] if force_cpu else [])
+        t0 = time.time()
+        proc = _run_child(args, timeout=budget)
+        rc = "timeout" if proc is None else proc.returncode
         try:
-            proc = subprocess.run(
-                [
-                    sys.executable,
-                    os.path.abspath(__file__),
-                    "--arm",
-                    "on" if dbs_on else "off",
-                    "--epochs",
-                    str(n_epochs),
-                    "--out",
-                    out_path,
-                ],
-                capture_output=True,
-                text=True,
-                timeout=int(os.environ.get("BENCH_ARM_TIMEOUT", 5400)),
-            )
-            if proc.returncode == 0:
-                with open(out_path) as f:
-                    return json.load(f)["walls"]
-            sys.stderr.write(
-                f"[bench] arm dbs={dbs_on} attempt {attempt + 1} failed "
-                f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}\n"
-            )
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(
-                f"[bench] arm dbs={dbs_on} attempt {attempt + 1} timed out\n"
-            )
+            with open(out_path) as f:
+                partial = json.load(f)
+        except Exception:
+            partial = {}
         finally:
             try:
                 os.unlink(out_path)
             except OSError:
                 pass
-        if attempt < retries - 1:
-            # progressive backoff: a crashed TPU runtime/tunnel can take
-            # minutes to come back (observed on this host)
-            time.sleep(min(60 * (attempt + 1), 240))
-    raise RuntimeError(f"arm dbs={dbs_on} failed after {retries} attempts")
+        res = _result_from(partial)
+        if res is not None:
+            quality = (
+                len(partial.get("off", [])) + len(partial.get("on", [])),
+                int(partial.get("n_train") or 0),
+            )
+            if quality > best_quality:  # keep the best salvage, not the latest
+                best, best_quality = res, quality
+            if proc is not None and proc.returncode == 0:
+                return best
+        sys.stderr.write(
+            f"[bench] arms(cpu={force_cpu}) attempt {attempt+1} rc={rc} "
+            f"({time.time()-t0:.0f}s, ntrain={env_n}); partial epochs "
+            f"off={len(partial.get('off', []))} on={len(partial.get('on', []))}\n"
+        )
+        if proc is not None and proc.stderr:
+            sys.stderr.write(proc.stderr[-1500:] + "\n")
+    return best
 
 
 def main() -> int:
-    import numpy as np
-
-    if "--arm" in sys.argv:
-        i = sys.argv.index("--arm")
-        dbs_on = sys.argv[i + 1] == "on"
-        n_epochs = int(sys.argv[sys.argv.index("--epochs") + 1])
+    global _best_result
+    if "--preflight" in sys.argv:
+        return run_preflight()
+    if "--arms" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
-        run_arm(dbs_on, n_epochs, out_path)
+        return run_arms(out_path, force_cpu="--cpu" in sys.argv)
+
+    signal.signal(signal.SIGTERM, _emit_and_exit)
+    signal.signal(signal.SIGINT, _emit_and_exit)
+
+    t_start = time.time()
+    deadline = t_start + float(os.environ.get("BENCH_TOTAL_BUDGET", 5400))
+    reserve = float(os.environ.get("BENCH_ARM_RESERVE", 1800))
+    retries = int(os.environ.get("BENCH_RETRIES", 3))
+    force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+    insurance_on = os.environ.get("BENCH_CPU_INSURANCE", "1") == "1"
+
+    if force_cpu:
+        _best_result = _try_arms(force_cpu=True, deadline=deadline, retries=retries)
+        if _best_result is None:
+            sys.stderr.write("[bench] no result obtained\n")
+            return 1
+        print(json.dumps(_best_result), flush=True)
         return 0
 
-    # epoch 0: calibration (no injection); epoch 1: first injected epoch;
-    # 2+: DBS reaction — the minimum meaningful A/B needs 4 on-arm epochs
-    epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
-    retries = int(os.environ.get("BENCH_RETRIES", 4))
+    tpu_ok = False
+    ladder = [
+        float(x)
+        for x in os.environ.get(
+            "BENCH_PREFLIGHT_TIMEOUTS", "600,1500,2400"
+        ).split(",")
+    ]
+    i = 0
+    while time.time() < deadline - reserve:
+        cap = ladder[min(i, len(ladder) - 1)]
+        cap = min(cap, deadline - reserve - time.time())
+        if cap < 60:
+            break
+        sys.stderr.write(f"[bench] preflight attempt {i+1} (cap {cap:.0f}s)\n")
+        proc = _run_child(["--preflight"], timeout=cap)
+        if proc is not None and proc.returncode == 0:
+            sys.stderr.write(f"[bench] preflight ok: {proc.stdout.strip()}\n")
+            tpu_ok = True
+            break
+        rc = "timeout" if proc is None else proc.returncode
+        sys.stderr.write(f"[bench] preflight failed (rc={rc})\n")
+        if i == 0 and insurance_on and _best_result is None:
+            sys.stderr.write("[bench] running CPU insurance arms\n")
+            _best_result = _try_arms(
+                force_cpu=True,
+                deadline=min(time.time() + 1500, deadline),
+                retries=1,
+            )
+        i += 1
+        time.sleep(30)
 
-    # Epoch 0 of each arm is injection-free (cost calibration) and epoch 1 is
-    # the first injected epoch; steady state is the tail.
-    walls_off = run_arm_with_retries(False, max(3, epochs - 2), retries)
-    walls_on = run_arm_with_retries(True, epochs, retries)
-    off_steady = float(np.min(walls_off[1:]))
-    on_steady = float(np.min(walls_on[2:]))
-    speedup = off_steady / on_steady
-
-    print(
-        json.dumps(
-            {
-                "metric": "densenet121_cifar10_ws4_3to1straggler_epoch_wallclock",
-                "value": round(on_steady, 4),
-                "unit": "s",
-                "vs_baseline": round(speedup, 4),
-                "detail": {
-                    "dbs_off_epochs_s": [round(w, 4) for w in walls_off],
-                    "dbs_on_epochs_s": [round(w, 4) for w in walls_on],
-                    "n_train": int(os.environ.get("BENCH_NTRAIN", 12800)),
-                    "world_size": int(os.environ.get("BENCH_WS", 4)),
-                },
-            }
+    if tpu_ok:
+        res = _try_arms(force_cpu=False, deadline=deadline, retries=retries)
+        if res is not None:
+            _best_result = res  # a TPU number beats any insurance
+    if _best_result is None and insurance_on:
+        _best_result = _try_arms(
+            force_cpu=True, deadline=max(deadline, time.time() + 900), retries=1
         )
-    )
+    if _best_result is None:
+        sys.stderr.write("[bench] no result obtained\n")
+        return 1
+    print(json.dumps(_best_result), flush=True)
     return 0
 
 

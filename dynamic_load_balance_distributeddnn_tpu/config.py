@@ -95,6 +95,12 @@ class Config:
                                        # kernel; NOTE: drops attention-prob
                                        # dropout (a semantics change, hence a
                                        # separate knob from use_pallas)
+    warm_start: bool = False           # pre-compile the whole bucketed batch
+                                       # shape ladder before epoch 0, so DBS
+                                       # rebalances never pay an XLA compile
+                                       # inside a timed epoch (benchmarks set
+                                       # this; the persistent compile cache
+                                       # makes it cheap on reruns)
 
     def __post_init__(self):
         if self.model not in MODELS:
@@ -185,6 +191,7 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile_dir", type=str, default=d.profile_dir)
     p.add_argument("--use_pallas", type=str2bool, default=d.use_pallas)
     p.add_argument("--use_flash_attention", type=str2bool, default=d.use_flash_attention)
+    p.add_argument("--warm_start", type=str2bool, default=d.warm_start)
     return p
 
 

@@ -52,11 +52,14 @@ class MetricsRecorder:
         self.data: Dict[str, List] = {k: [] for k in SERIES}
 
     def record_epoch(self, **kw) -> None:
+        """The reference's nine series are mandatory; extra keyword series
+        (e.g. ``examples_per_s``, ``mfu_bf16_peak`` — the TPU build's
+        throughput/MFU instrumentation) are recorded alongside them."""
         missing = set(SERIES) - set(kw)
         if missing:
             raise ValueError(f"missing series: {sorted(missing)}")
-        for k in SERIES:
-            self.data[k].append(_pythonize(kw[k]))
+        for k, v in kw.items():
+            self.data.setdefault(k, []).append(_pythonize(v))
 
     def save(self, stat_dir: str, base_filename: str, rank: int = 0) -> str:
         os.makedirs(stat_dir, exist_ok=True)

@@ -174,6 +174,12 @@ class Trainer:
         # Fused-path sync-time meter: seconds of collective cost per step,
         # measured once per run (shapes are constant on the fused path).
         self._fused_sync_per_step: Optional[float] = None
+        # FLOP accounting (obs/flops.py): per-padded-example step FLOPs from
+        # XLA's cost model, measured once per run; per-epoch totals derive
+        # from each epoch's plan. None when the backend exposes no cost model.
+        self._flops_per_padded_example: Optional[float] = None
+        self._epoch_flops: Optional[float] = None
+        self._warmed = False
 
     # -------------------------------------------------------------- set-up
     # Subclass hooks: the LM trainer (train/lm_engine.py) overrides these.
@@ -232,6 +238,53 @@ class Trainer:
 
     # ------------------------------------------------------------------ run
 
+    def _dummy_batch(self, b: int):
+        """Zero-filled (x, y, w) for one padded batch of ``b`` — the warm-up
+        compile driver. Vision layout; the LM trainer overrides."""
+        h, w_, c = self.bundle.train_x.shape[1:]
+        return (
+            np.zeros((b, h, w_, c), dtype=self.bundle.train_x.dtype),
+            np.zeros((b,), dtype=np.int32),
+            np.full((b,), 1.0 / max(b * self.cfg.world_size, 1), dtype=np.float32),
+        )
+
+    def _warm_shapes(self) -> None:
+        """Pre-compile the elastic step for every padded batch shape the
+        balancer can produce (multiples of ``bucket`` up to the capacity cap),
+        on every used device. Without this, each rebalance's fresh shape pays
+        its XLA compile inside a timed epoch — on short benchmark runs the
+        compiles dominate and bury the balancer's actual win. One-time cost,
+        amortized further by the persistent compilation cache."""
+        cfg = self.cfg
+        max_share = min(1.0, cfg.capacity_factor / cfg.world_size)
+        max_b = -(-int(np.ceil(max_share * cfg.batch_size)) // cfg.bucket) * cfg.bucket
+        ladder = list(range(cfg.bucket, max_b + 1, cfg.bucket))
+        key = jax.random.PRNGKey(0)
+        slow = jnp.int32(0)
+        t0 = time.perf_counter()
+        views = shard_views(self.state.params, self.topology.devices)
+        # the accumulate variant only runs where workers share a device
+        warm_acc = any(len(g) > 1 for g in self.topology.groups.values())
+        for d in self.topology.used_device_indices:
+            dev = self.topology.devices[d]
+            for b in ladder:
+                x, y, w = self._dummy_batch(b)
+                args = (
+                    jax.device_put(x, dev),
+                    jax.device_put(y, dev),
+                    jax.device_put(w, dev),
+                    jax.device_put(key, dev),
+                    jax.device_put(slow, dev),
+                )
+                acc, aux = self.steps.worker_step_first(views[d], *args)
+                if warm_acc:
+                    acc, aux = self.steps.worker_step_acc(views[d], acc, *args)
+                jax.block_until_ready(aux)
+        self.logger.info(
+            f"Warm start: compiled {len(ladder)} batch shapes "
+            f"(up to {max_b}) in {time.perf_counter() - t0:.1f}s"
+        )
+
     def run(self, epochs: Optional[int] = None) -> MetricsRecorder:
         cfg = self.cfg
         epochs = cfg.epoch_size if epochs is None else epochs
@@ -239,6 +292,7 @@ class Trainer:
             f"Starting: {cfg.model}/{cfg.dataset}, ws={cfg.world_size}, "
             f"B={cfg.batch_size}, devices={self.n_dev}, dbs={cfg.dynamic_batch_size}"
         )
+        self._maybe_warm()
         start_epoch = 0
         if cfg.ckpt_dir:
             start_epoch = self._maybe_restore()
@@ -293,8 +347,14 @@ class Trainer:
         self.logger.info(f"Resumed from checkpoint at epoch {epoch}")
         return epoch + 1
 
+    def _maybe_warm(self) -> None:
+        if self.cfg.warm_start and not self._warmed:
+            self._warmed = True
+            self._warm_shapes()
+
     def run_epoch(self, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
+        self._maybe_warm()  # callers driving epochs directly still warm first
         lr = one_cycle_lr(
             cfg.learning_rate,
             epoch,
@@ -373,6 +433,25 @@ class Trainer:
             f"accuracy {accuracy:.2f}, wall {epoch_wall:.3f}s"
         )
 
+        # Throughput/MFU extras (obs/flops.py): examples/s for vision, tokens/s
+        # for the LM (n_train counts tokens there); MFU against the mesh's
+        # aggregate bf16 peak, from XLA-cost-model FLOPs of the real plan.
+        extras = {}
+        if epoch_wall > 0:
+            extras["examples_per_s"] = self.n_train / epoch_wall
+        ppe = self._flops_per_padded_example
+        if ppe is not None and ppe > 0:
+            self._epoch_flops = ppe * float(
+                sum(w.padded_batch * w.steps for w in plan.workers)
+            )
+            extras["flops_per_epoch"] = self._epoch_flops
+            if epoch_wall > 0:
+                from dynamic_load_balance_distributeddnn_tpu.obs.flops import mfu
+
+                u = mfu(self._epoch_flops / epoch_wall, self.n_dev)
+                if u is not None:
+                    extras["mfu_bf16_peak"] = u
+
         self.recorder.record_epoch(
             epoch=epoch,
             train_loss=train_metrics["loss"],
@@ -383,6 +462,7 @@ class Trainer:
             partition=self.shares.tolist(),
             node_time=self.node_times.tolist(),
             wallclock_time=self.total_wallclock,
+            **extras,
         )
         return {
             "epoch_wall": epoch_wall,
@@ -457,6 +537,24 @@ class Trainer:
             self._fused_sync_per_step = self._probe_fused_sync(
                 xs, ys, ws_, slow, jnp.int32(cfg.seed * 31 + epoch)
             )
+            if self._flops_per_padded_example is None:
+                from dynamic_load_balance_distributeddnn_tpu.obs.flops import (
+                    compiled_flops,
+                )
+
+                f = compiled_flops(
+                    self.steps.fused_step_probe,
+                    self.state, xs[0], ys[0], ws_[0], slow,
+                    jnp.int32(cfg.seed * 31 + epoch),
+                )
+                # cost_analysis reports the PER-DEVICE partitioned module's
+                # FLOPs (it processes global_batch / n_dev examples), so
+                # normalize by the per-device slice — consistent with the
+                # elastic path's single-device normalization
+                per_dev_batch = max(xs.shape[1] // max(self.n_dev, 1), 1)
+                self._flops_per_padded_example = (
+                    f / per_dev_batch if f else -1.0
+                )
             # one-time instrumentation (2 extra XLA compiles + probe steps);
             # excluded from the epoch wall so the benchmark's fused-arm
             # wallclock stays comparable to the elastic arm
@@ -593,6 +691,28 @@ class Trainer:
         for r in range(cfg.world_size):
             self.timekeeper.add_injected(r, float(faults.virtual_seconds[r]))
 
+        flops_probe_overhead = 0.0
+        if self._flops_per_padded_example is None:
+            from dynamic_load_balance_distributeddnn_tpu.obs.flops import (
+                compiled_flops,
+            )
+
+            # One-time AOT lower+compile for cost analysis — excluded from
+            # the epoch wall (mirrors the fused path's probe_overhead).
+            t0 = time.perf_counter()
+            d0 = topo.used_device_indices[0]
+            r0 = topo.groups[d0][0]
+            x, y, w = data[r0]
+            views = shard_views(self.state.params, topo.devices)
+            f = compiled_flops(
+                self.steps.worker_step_first,
+                views[d0],
+                jnp.asarray(x[0]), jnp.asarray(y[0]), jnp.asarray(w[0]),
+                base_key, jnp.int32(0),
+            )
+            self._flops_per_padded_example = f / max(x.shape[1], 1) if f else -1.0
+            flops_probe_overhead = time.perf_counter() - t0
+
         wloss = float(np.sum([float(a[0]) for a in aux_acc]))
         loss_sum = float(np.sum([float(a[1]) for a in aux_acc]))
         count = float(np.sum([float(a[2]) for a in aux_acc]))
@@ -608,6 +728,7 @@ class Trainer:
             "loss": loss_sum / max(count, 1.0),
             "wloss": wloss / max(plan.num_steps, 1),
             "sync_time": sync_probe * plan.num_steps,
+            "probe_overhead": flops_probe_overhead,
         }
 
     def _probe_workers(
@@ -667,6 +788,8 @@ class Trainer:
         stacked = stack_partials(
             [partials[d] for d in topo.used_device_indices], self.mesh
         )
+        # warm (compile) untimed, then time the pure collective+update
+        jax.block_until_ready(self.steps.combine_probe(self.state, stacked).params)
         t0 = time.perf_counter()
         probed = self.steps.combine_probe(self.state, stacked)
         jax.block_until_ready(probed.params)

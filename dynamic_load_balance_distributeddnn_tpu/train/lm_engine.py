@@ -103,6 +103,15 @@ class LMTrainer(Trainer):
             use_pallas=cfg.use_pallas,
         )
 
+    def _dummy_batch(self, b: int):
+        """LM warm-up batch: ``b`` padded columns of bptt-token windows."""
+        cfg = self.cfg
+        return (
+            np.zeros((b, cfg.bptt), dtype=np.int32),
+            np.zeros((b, cfg.bptt), dtype=np.int32),
+            np.zeros((b, cfg.bptt), dtype=np.float32),
+        )
+
     # ------------------------------------------------------------- planning
 
     def _build_plan(self, epoch: int, batch_sizes: np.ndarray) -> EpochPlan:

@@ -1,0 +1,18 @@
+"""One backend-init attempt; prints one status line. Used by the watcher."""
+import os, sys, time, threading
+t0 = time.time()
+cap = float(os.environ.get("PROBE_CAP_S", "1800"))
+def watchdog():
+    time.sleep(cap)
+    print(f"PROBE timeout after {cap:.0f}s", flush=True)
+    os._exit(17)
+threading.Thread(target=watchdog, daemon=True).start()
+import jax
+try:
+    ds = jax.devices()
+    import jax.numpy as jnp
+    jax.block_until_ready(jnp.ones((128,128), jnp.bfloat16) @ jnp.ones((128,128), jnp.bfloat16))
+    print(f"PROBE ok in {time.time()-t0:.0f}s: {ds[0].platform}/{getattr(ds[0],'device_kind','?')} n={len(ds)}", flush=True)
+except Exception as e:
+    print(f"PROBE fail after {time.time()-t0:.0f}s: {type(e).__name__}: {e}", flush=True)
+    sys.exit(1)

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Loop backend-init probes; log to .tpu_watch.log; touch .tpu_up on success.
+cd /root/repo
+while true; do
+  echo "[$(date +%H:%M:%S)] probing..." >> .tpu_watch.log
+  if PROBE_CAP_S=2400 python scripts/tpu_probe_once.py >> .tpu_watch.log 2>&1; then
+    date +%H:%M:%S > .tpu_up
+    echo "[$(date +%H:%M:%S)] TPU UP" >> .tpu_watch.log
+    sleep 600   # don't hammer claims while up; re-confirm every 10 min
+  else
+    sleep 120
+  fi
+done

@@ -83,6 +83,15 @@ def quantize_batches(
         if units[i] < 1 and leftover > 0:
             units[i] += 1
             leftover -= 1
+    # Any remaining leftover goes to whoever is furthest below their ideal
+    # fractional unit share, so the effective global step size always equals
+    # the requested global batch (never silently shrinks).
+    if leftover > 0:
+        ideal = b.astype(np.float64) / max(b.sum(), 1) * units_total
+        while leftover > 0:
+            i = int(np.argmax(ideal - units))
+            units[i] += 1
+            leftover -= 1
     for i in range(n):
         while units[i] < 1:
             j = int(np.argmax(units))

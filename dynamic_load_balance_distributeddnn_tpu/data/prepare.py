@@ -26,12 +26,14 @@ import zipfile
 from typing import Optional
 
 _FASHION_BASE = "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/"
-_FASHION_FILES = [
-    "train-images-idx3-ubyte.gz",
-    "train-labels-idx1-ubyte.gz",
-    "t10k-images-idx3-ubyte.gz",
-    "t10k-labels-idx1-ubyte.gz",
-]
+# name -> md5 (torchvision's published checksums; the mirror is plain HTTP,
+# so integrity comes from the hash, not the transport)
+_FASHION_FILES = {
+    "train-images-idx3-ubyte.gz": "8d4fb7e6c68d591d4c3dfef9ec88bf0d",
+    "train-labels-idx1-ubyte.gz": "25c81989df183df01b3e8a0aad5dffbe",
+    "t10k-images-idx3-ubyte.gz": "bef4ecab320f06d8554ea6380940ec79",
+    "t10k-labels-idx1-ubyte.gz": "bb300cfdad3c16e7a12a480ee83cd310",
+}
 _CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
 _CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
 _CIFAR100_URL = "https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz"
@@ -71,14 +73,25 @@ def _fetch(url: str, dest: str, md5: Optional[str] = None, timeout: int = 60) ->
 def prepare_fashion_mnist(data_dir: str) -> bool:
     raw = os.path.join(data_dir, "FashionMNIST", "raw")
     ok = True
-    for name in _FASHION_FILES:
-        ok &= _fetch(_FASHION_BASE + name, os.path.join(raw, name))
+    for name, md5 in _FASHION_FILES.items():
+        ok &= _fetch(_FASHION_BASE + name, os.path.join(raw, name), md5)
     return ok
 
 
-def _untar(archive: str, into: str) -> None:
-    with tarfile.open(archive, "r:gz") as tf:
-        tf.extractall(into)
+def _untar(archive: str, into: str) -> bool:
+    """Extract, degrading a truncated/corrupt archive to a warning (the
+    offline-safe contract: every failure falls back to synthetic data)."""
+    try:
+        with tarfile.open(archive, "r:gz") as tf:
+            tf.extractall(into)
+        return True
+    except (tarfile.ReadError, EOFError, OSError) as e:
+        print(f"  corrupt archive {archive} ({e}); discarding", file=sys.stderr)
+        try:
+            os.unlink(archive)  # let a rerun re-fetch it
+        except OSError:
+            pass
+        return False
 
 
 def prepare_cifar(data_dir: str, name: str) -> bool:
@@ -92,7 +105,8 @@ def prepare_cifar(data_dir: str, name: str) -> bool:
     archive = os.path.join(data_dir, os.path.basename(url))
     if not _fetch(url, archive, md5):
         return False
-    _untar(archive, data_dir)
+    if not _untar(archive, data_dir):
+        return False
     return os.path.isdir(os.path.join(data_dir, marker))
 
 
@@ -107,8 +121,19 @@ def prepare_wikitext2(lm_data_dir: str) -> bool:
     archive = os.path.join(parent, "wikitext-2-v1.zip")
     if not _fetch(_WIKITEXT2_URL, archive):
         return False
-    with zipfile.ZipFile(archive) as zf:
-        zf.extractall(parent)
+    # No pinned md5 (upstream re-hosts have varied); zip CRCs checked on
+    # extraction are the integrity guarantee, and corruption degrades to a
+    # warning + re-fetchable state rather than a crash.
+    try:
+        with zipfile.ZipFile(archive) as zf:
+            zf.extractall(parent)
+    except (zipfile.BadZipFile, EOFError, OSError) as e:
+        print(f"  corrupt archive {archive} ({e}); discarding", file=sys.stderr)
+        try:
+            os.unlink(archive)
+        except OSError:
+            pass
+        return False
     src = os.path.join(parent, "wikitext-2")
     os.makedirs(lm_data_dir, exist_ok=True)
     ok = True

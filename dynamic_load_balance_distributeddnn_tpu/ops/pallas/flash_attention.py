@@ -329,6 +329,21 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _tpu_block_sizes(t16: int, block_q: int, block_k: int) -> "tuple[int, int]":
+    """Snap block sizes to Mosaic lane-tiling-safe values for real-TPU runs.
+
+    The lse output block is ``(1, block_q)`` — block_q sits in the LANE
+    dimension, so a block smaller than the padded time axis must be a
+    multiple of 128 lanes. Short sequences (t16 < 128) use the full width
+    (block == padded array dim, which Mosaic masks internally); otherwise
+    blocks round to 128 multiples. Interpret mode is unconstrained."""
+    if t16 < 128:
+        return t16, t16
+    bq = max(128, (block_q // 128) * 128)
+    bk = max(128, (block_k // 128) * 128)
+    return bq, bk
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -348,6 +363,8 @@ def flash_attention(
     t16 = -(-t // 16) * 16  # sublane-aligned cap for short sequences
     block_q = min(block_q, t16)
     block_k = min(block_k, t16)
+    if not interpret:
+        block_q, block_k = _tpu_block_sizes(t16, block_q, block_k)
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)

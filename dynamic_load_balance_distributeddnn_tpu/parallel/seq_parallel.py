@@ -44,14 +44,25 @@ def make_seq_parallel_apply(
 
 
 def make_seq_parallel_value_and_grad(
-    mesh: Mesh, model, axis_name: str = DATA_AXIS
+    mesh: Mesh, model, axis_name: str = DATA_AXIS, train: bool = False
 ) -> Callable:
-    """jit-ready ``(params, tokens, targets) -> (mean_xent, grads)`` over a
-    T-sharded global sequence; loss and grads are psum-combined so every
-    shard (and the caller) sees the global values."""
+    """jit-ready ``(params, tokens, targets, rng=None) -> (mean_xent, grads)``
+    over a T-sharded global sequence; loss and grads are psum-combined so
+    every shard (and the caller) sees the global values.
 
-    def local_loss(params, tokens, targets):
-        logits = model.apply(params, tokens, train=False)
+    ``train=True`` enables the model's configured dropout: each shard derives
+    its stream by folding the replicated ``rng`` with its axis index, so a
+    logical token (resident on exactly one shard) is dropped exactly once.
+    ``train=False`` (default) is the deterministic eval/grad-check mode the
+    numerics tests compare against the single-device model."""
+
+    def local_loss(params, tokens, targets, rng):
+        rngs = (
+            {"dropout": jax.random.fold_in(rng, jax.lax.axis_index(axis_name))}
+            if train
+            else None
+        )
+        logits = model.apply(params, tokens, train=train, rngs=rngs)
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(
             logits, targets[..., None], axis=-1
@@ -68,11 +79,18 @@ def make_seq_parallel_value_and_grad(
     sharded_loss = jax.shard_map(
         local_loss,
         mesh=mesh,
-        in_specs=(P(), P(None, axis_name), P(None, axis_name)),
+        in_specs=(P(), P(None, axis_name), P(None, axis_name), P()),
         out_specs=P(),
         check_vma=False,
     )
-    return jax.jit(jax.value_and_grad(sharded_loss))
+    vg = jax.jit(jax.value_and_grad(sharded_loss))
+
+    def call(params, tokens, targets, rng=None):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return vg(params, tokens, targets, rng)
+
+    return call
 
 
 def shard_tokens(mesh: Mesh, tokens, axis_name: str = DATA_AXIS):

@@ -180,6 +180,7 @@ class Trainer:
         self._flops_per_padded_example: Optional[float] = None
         self._epoch_flops: Optional[float] = None
         self._warmed = False
+        self._probes_ran = False  # replicated across processes by construction
 
     # -------------------------------------------------------------- set-up
     # Subclass hooks: the LM trainer (train/lm_engine.py) overrides these.
@@ -421,9 +422,10 @@ class Trainer:
             self.node_times = a * self.node_times + (1.0 - a) * fresh
         else:
             self.node_times = fresh
-        if self.n_proc > 1 and np.isfinite(
-            self.per_example_cost[self.rank_lo : self.rank_lo + self.ws_local]
-        ).all():
+        # Gate the collective on REPLICATED state (the probes-ran flag derives
+        # from config alone), never on locally-measured values: a gate that
+        # could differ per process would deadlock the process_allgather.
+        if self.n_proc > 1 and self._probes_ran:
             self.per_example_cost = exchange_times(
                 self.per_example_cost[self.rank_lo : self.rank_lo + self.ws_local]
             )
@@ -684,6 +686,10 @@ class Trainer:
             cfg.dynamic_batch_size or self._needs_iter_cost
         ):
             sync_probe = self._probe_workers(plan, data, faults, epoch)
+            # Replicated-state flag: this condition is identical on every
+            # process (pure config), so gating later collectives on it can
+            # never diverge across hosts.
+            self._probes_ran = True
         if self.timing_model is not None:
             modeled = np.asarray(self.timing_model(plan), dtype=np.float64)
             for r in range(cfg.world_size):

@@ -83,7 +83,7 @@ def _untar(archive: str, into: str) -> bool:
     offline-safe contract: every failure falls back to synthetic data)."""
     try:
         with tarfile.open(archive, "r:gz") as tf:
-            tf.extractall(into)
+            tf.extractall(into, filter="data")
         return True
     except (tarfile.ReadError, EOFError, OSError) as e:
         print(f"  corrupt archive {archive} ({e}); discarding", file=sys.stderr)

@@ -1,0 +1,195 @@
+"""Downloader → unpack → reader chain, proven against local fixtures.
+
+The reference pre-downloads with torchvision (prepare_data.py:4-10); our
+``data/prepare.py`` fetches the same archives with urllib. No network egress
+exists here, so these tests serve hand-built miniature archives over
+``file://`` URLs and assert the full chain lands in layouts that
+``load_dataset`` / ``Corpus`` actually read (synthetic=False round trip).
+"""
+
+import gzip
+import hashlib
+import os
+import pickle
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.data import prepare
+from dynamic_load_balance_distributeddnn_tpu.data.corpus import Corpus
+from dynamic_load_balance_distributeddnn_tpu.data.datasets import load_dataset
+
+
+def _md5(path):
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
+def _file_url(path):
+    return "file://" + os.path.abspath(path)
+
+
+def _write_idx(path, magic, arr):
+    """Minimal idx writer (gzipped), the format torchvision's raw files use."""
+    dims = arr.shape
+    header = int(magic).to_bytes(4, "big") + b"".join(
+        int(d).to_bytes(4, "big") for d in dims
+    )
+    with gzip.open(path, "wb") as f:
+        f.write(header + arr.astype(np.uint8).tobytes())
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def test_fashion_mnist_chain(tmp_path, monkeypatch, rng):
+    src = tmp_path / "src"
+    src.mkdir()
+    n_tr, n_te = 8, 4
+    imgs = {
+        "train-images-idx3-ubyte.gz": (2051, rng.randint(0, 256, (n_tr, 28, 28))),
+        "train-labels-idx1-ubyte.gz": (2049, rng.randint(0, 10, (n_tr,))),
+        "t10k-images-idx3-ubyte.gz": (2051, rng.randint(0, 256, (n_te, 28, 28))),
+        "t10k-labels-idx1-ubyte.gz": (2049, rng.randint(0, 10, (n_te,))),
+    }
+    md5s = {}
+    for name, (magic, arr) in imgs.items():
+        _write_idx(str(src / name), magic, arr)
+        md5s[name] = _md5(str(src / name))
+    monkeypatch.setattr(prepare, "_FASHION_BASE", _file_url(str(src)) + "/")
+    monkeypatch.setattr(prepare, "_FASHION_FILES", md5s)
+
+    data_dir = str(tmp_path / "data")
+    assert prepare.prepare_fashion_mnist(data_dir)
+    bundle = load_dataset("mnist", data_dir=data_dir)
+    assert not bundle.synthetic
+    assert bundle.train_x.shape == (n_tr, 28, 28, 1)
+    assert bundle.test_y.shape == (n_te,)
+    np.testing.assert_array_equal(
+        bundle.train_x[..., 0], imgs["train-images-idx3-ubyte.gz"][1]
+    )
+
+
+def test_fashion_mnist_checksum_mismatch_degrades(tmp_path, monkeypatch, rng):
+    src = tmp_path / "src"
+    src.mkdir()
+    _write_idx(str(src / "train-images-idx3-ubyte.gz"), 2051, rng.randint(0, 256, (2, 28, 28)))
+    monkeypatch.setattr(prepare, "_FASHION_BASE", _file_url(str(src)) + "/")
+    monkeypatch.setattr(
+        prepare, "_FASHION_FILES", {"train-images-idx3-ubyte.gz": "0" * 32}
+    )
+    data_dir = str(tmp_path / "data")
+    assert not prepare.prepare_fashion_mnist(data_dir)
+    # the mismatching file must not have been kept
+    assert not os.path.exists(
+        os.path.join(data_dir, "FashionMNIST", "raw", "train-images-idx3-ubyte.gz")
+    )
+
+
+def _cifar10_tarball(path, rng, n_per_batch=4):
+    """cifar-10-batches-py layout: 5 train pickles + test_batch."""
+    stage = os.path.join(os.path.dirname(path), "cifar-10-batches-py")
+    os.makedirs(stage, exist_ok=True)
+    batches = {}
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        d = {
+            "data": rng.randint(0, 256, (n_per_batch, 3072)).astype(np.uint8),
+            "labels": rng.randint(0, 10, (n_per_batch,)).tolist(),
+        }
+        with open(os.path.join(stage, name), "wb") as f:
+            pickle.dump(d, f)
+        batches[name] = d
+    with tarfile.open(path, "w:gz") as tf:
+        tf.add(stage, arcname="cifar-10-batches-py")
+    return batches
+
+
+def test_cifar10_chain(tmp_path, monkeypatch, rng):
+    src = tmp_path / "src"
+    src.mkdir()
+    archive = str(src / "cifar-10-python.tar.gz")
+    batches = _cifar10_tarball(archive, rng)
+    monkeypatch.setattr(prepare, "_CIFAR10_URL", _file_url(archive))
+    monkeypatch.setattr(prepare, "_CIFAR10_MD5", _md5(archive))
+
+    data_dir = str(tmp_path / "data")
+    assert prepare.prepare_cifar(data_dir, "cifar10")
+    bundle = load_dataset("cifar10", data_dir=data_dir)
+    assert not bundle.synthetic
+    assert bundle.train_x.shape == (20, 32, 32, 3)  # 5 batches x 4
+    assert bundle.test_x.shape == (4, 32, 32, 3)
+    want = (
+        batches["data_batch_1"]["data"][0]
+        .reshape(3, 32, 32)
+        .transpose(1, 2, 0)
+    )
+    np.testing.assert_array_equal(bundle.train_x[0], want)
+
+
+def test_cifar10_corrupt_archive_degrades(tmp_path, monkeypatch):
+    src = tmp_path / "src"
+    src.mkdir()
+    archive = str(src / "cifar-10-python.tar.gz")
+    with open(archive, "wb") as f:
+        f.write(b"not a tarball at all")
+    monkeypatch.setattr(prepare, "_CIFAR10_URL", _file_url(archive))
+    monkeypatch.setattr(prepare, "_CIFAR10_MD5", _md5(archive))
+    data_dir = str(tmp_path / "data")
+    # degrades to False (synthetic fallback), never raises
+    assert not prepare.prepare_cifar(data_dir, "cifar10")
+    # and load_dataset falls back to the synthetic stand-in
+    assert load_dataset("cifar10", data_dir=data_dir, n_train=64).synthetic
+
+
+def test_wikitext2_chain(tmp_path, monkeypatch):
+    src = tmp_path / "src"
+    src.mkdir()
+    archive = str(src / "wikitext-2-v1.zip")
+    text = {
+        "train": "the quick brown fox jumps over the lazy dog\n" * 50,
+        "valid": "pack my box with five dozen liquor jugs\n" * 10,
+        "test": "sphinx of black quartz judge my vow\n" * 10,
+    }
+    with zipfile.ZipFile(archive, "w") as zf:
+        for split, body in text.items():
+            zf.writestr(f"wikitext-2/wiki.{split}.tokens", body)
+    monkeypatch.setattr(prepare, "_WIKITEXT2_URL", _file_url(archive))
+
+    lm_dir = str(tmp_path / "out" / "wikitext-2")
+    assert prepare.prepare_wikitext2(lm_dir)
+    for split in ("train", "valid", "test"):
+        assert os.path.exists(os.path.join(lm_dir, f"{split}.txt"))
+    corpus = Corpus(lm_dir)
+    assert not getattr(corpus, "synthetic", False)
+    assert corpus.ntokens > 0
+    # every word of the tiny train text must be in the vocab
+    assert corpus.train.size >= 50 * 9
+
+
+def test_wikitext2_corrupt_zip_degrades(tmp_path, monkeypatch):
+    src = tmp_path / "src"
+    src.mkdir()
+    archive = str(src / "wikitext-2-v1.zip")
+    with open(archive, "wb") as f:
+        f.write(b"PK\x03\x04 truncated junk")
+    monkeypatch.setattr(prepare, "_WIKITEXT2_URL", _file_url(archive))
+    assert not prepare.prepare_wikitext2(str(tmp_path / "out" / "wikitext-2"))
+
+
+def test_prepare_main_offline_exits_nonzero(tmp_path, monkeypatch):
+    """main() with unreachable mirrors: warns, returns 1, never raises."""
+
+    def _no_fetch(url, dest, md5=None, timeout=60):
+        return False
+
+    monkeypatch.setattr(prepare, "_fetch", _no_fetch)
+    rc = prepare.main(
+        ["--data_dir", str(tmp_path / "d"), "--lm_data_dir", str(tmp_path / "lm")]
+    )
+    assert rc == 1

@@ -103,9 +103,13 @@ def _write_atomic(path: str, obj) -> None:
     os.replace(tmp, path)
 
 
-def run_arms(out_path: str, force_cpu: bool) -> int:
+def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
     """Run the dbs-off then dbs-on arm in THIS process (one backend init),
-    writing per-epoch walls + instrumentation incrementally to out_path."""
+    writing per-epoch walls + instrumentation incrementally to out_path.
+
+    ``resume_path``: a previous attempt's partial JSON; arms it already
+    completed (same backend/model/n_train) are copied, not re-run — a retry
+    after a mid-run runtime outage only pays for what was lost."""
     if force_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
@@ -148,11 +152,32 @@ def run_arms(out_path: str, force_cpu: bool) -> int:
         "on": [],
         "instr": {},
     }
+    resume = {}
+    if resume_path and os.path.exists(resume_path):
+        try:
+            with open(resume_path) as f:
+                prev = json.load(f)
+            if (
+                prev.get("backend") == out["backend"]
+                and prev.get("model") == model
+                and prev.get("n_train") == n_train
+            ):
+                resume = prev
+        except Exception:
+            pass
     _write_atomic(out_path, out)
 
     # epoch 0 calibrates (no injection), epoch 1 is the first injected epoch;
     # the off arm needs fewer epochs since it never rebalances
     for arm, dbs_on, n_ep in (("off", False, max(3, epochs - 2)), ("on", True, epochs)):
+        if len(resume.get(arm, [])) >= n_ep:
+            out[arm] = resume[arm][:n_ep]
+            for k, v in resume.get("instr", {}).items():
+                if k.startswith(arm + "_"):
+                    out["instr"][k] = v
+            _write_atomic(out_path, out)
+            sys.stderr.write(f"[bench] arm {arm} resumed from previous attempt\n")
+            continue
         cfg = Config(
             debug=False,
             world_size=ws,
@@ -243,21 +268,51 @@ def _run_child(args, timeout):
         return None
 
 
+def _wait_healthy(deadline: float) -> bool:
+    """Quick preflights until the runtime answers or the deadline passes.
+    After a mid-run outage (e.g. the remote-compile tunnel dropping), retrying
+    arms against a dead runtime just burns budget; a 1-matmul preflight is
+    cheap insurance."""
+    while time.time() < deadline:
+        cap = min(300.0, deadline - time.time())
+        if cap < 30:
+            return False
+        proc = _run_child(["--preflight"], timeout=cap)
+        if proc is not None and proc.returncode == 0:
+            return True
+        rc = "timeout" if proc is None else proc.returncode
+        sys.stderr.write(f"[bench] health re-check failed (rc={rc}); waiting\n")
+        time.sleep(30)
+    return False
+
+
 def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
     """Run the arms subprocess with retries; returns a result dict (possibly
-    from salvaged partials) or None."""
+    from salvaged partials) or None. Partials carry across attempts: a retry
+    resumes completed arms instead of re-running them."""
     best = None
     best_quality = (-1, -1)  # (epochs salvaged, n_train) — bigger is better
     n_train = int(os.environ.get("BENCH_NTRAIN", 12800))
+    epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
+    arm_needs = {"off": max(3, epochs - 2), "on": epochs}  # mirrors run_arms
+    resume_path = ""
+    shrink = 0
     for attempt in range(retries):
         budget = deadline - time.time()
         if budget < 120:
             break
+        if attempt > 0 and not force_cpu:
+            if not _wait_healthy(deadline - 60):
+                break
         fd, out_path = tempfile.mkstemp(suffix=".json")
         os.close(fd)
-        env_n = str(max(n_train // (2 ** attempt), 2560))  # salvage: shrink
+        # Salvage by shrinking — but never away from a resumable partial:
+        # a completed arm is only reusable at the same n_train.
+        env_n = str(max(n_train // (2 ** shrink), 2560))
         os.environ["BENCH_NTRAIN"] = env_n
         args = ["--arms", "--out", out_path] + (["--cpu"] if force_cpu else [])
+        if resume_path:
+            args += ["--resume", resume_path]
         t0 = time.time()
         proc = _run_child(args, timeout=budget)
         rc = "timeout" if proc is None else proc.returncode
@@ -266,11 +321,6 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
                 partial = json.load(f)
         except Exception:
             partial = {}
-        finally:
-            try:
-                os.unlink(out_path)
-            except OSError:
-                pass
         res = _result_from(partial)
         if res is not None:
             quality = (
@@ -280,7 +330,35 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
             if quality > best_quality:  # keep the best salvage, not the latest
                 best, best_quality = res, quality
             if proc is not None and proc.returncode == 0:
+                for p in (out_path, resume_path):
+                    if p:
+                        try:
+                            os.unlink(p)
+                        except OSError:
+                            pass
                 return best
+        # Keep this attempt's partial ONLY if a whole arm completed — that is
+        # what run_arms can actually resume (it requires >= n_ep epochs).
+        completed_arm = any(
+            len(partial.get(a, [])) >= n for a, n in arm_needs.items()
+        )
+        if completed_arm:
+            if resume_path:
+                try:
+                    os.unlink(resume_path)
+                except OSError:
+                    pass
+            resume_path = out_path
+        else:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+            if not resume_path:
+                # nothing salvageable anywhere — next attempt runs smaller.
+                # (Never shrink while a resumable partial exists: resume
+                # requires the same n_train.)
+                shrink += 1
         sys.stderr.write(
             f"[bench] arms(cpu={force_cpu}) attempt {attempt+1} rc={rc} "
             f"({time.time()-t0:.0f}s, ntrain={env_n}); partial epochs "
@@ -288,6 +366,11 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
         )
         if proc is not None and proc.stderr:
             sys.stderr.write(proc.stderr[-1500:] + "\n")
+    if resume_path:
+        try:
+            os.unlink(resume_path)
+        except OSError:
+            pass
     return best
 
 
@@ -297,7 +380,12 @@ def main() -> int:
         return run_preflight()
     if "--arms" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
-        return run_arms(out_path, force_cpu="--cpu" in sys.argv)
+        resume = (
+            sys.argv[sys.argv.index("--resume") + 1]
+            if "--resume" in sys.argv
+            else ""
+        )
+        return run_arms(out_path, force_cpu="--cpu" in sys.argv, resume_path=resume)
 
     signal.signal(signal.SIGTERM, _emit_and_exit)
     signal.signal(signal.SIGINT, _emit_and_exit)

@@ -14,7 +14,17 @@ import argparse
 import dataclasses
 from typing import List, Optional, Sequence
 
-MODELS = ["mnistnet", "resnet", "densenet", "googlenet", "regnet", "transformer"]
+# Family-default names mirror the reference switch (dbs.py:345-362); explicit
+# variants expose the full Net/ constructor surface (e.g. ResNet-18 for
+# BASELINE acceptance config #2).
+MODELS = [
+    "mnistnet",
+    "resnet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "densenet", "densenet121", "densenet169", "densenet201", "densenet161",
+    "googlenet",
+    "regnet", "regnetx200mf", "regnetx400mf", "regnety400mf",
+    "transformer",
+]
 DATASETS = ["cifar10", "cifar100", "mnist", "wikitext2"]
 
 
@@ -62,6 +72,10 @@ class Config:
 
     # ---- TPU-native knobs (new in this framework) ----
     seed: int = 1234                   # partitioner/model seed (dbs.py:313, 329)
+    n_train: int = 0                   # >0: truncate the train split to this
+                                       # many examples (tokens for the LM) —
+                                       # controlled-scale runs through the real
+                                       # entry point; 0 = full dataset
     momentum: float = 0.9              # SGD momentum (dbs.py:369)
     bucket: int = 16                   # batch shapes rounded up to a multiple of
                                        # this, bounding XLA recompiles while
@@ -79,6 +93,11 @@ class Config:
                                        # measured time vector (exact reference
                                        # semantics, dbs.py:94-129);
                                        # "compute": inject real on-device FLOPs
+    straggler: str = ""                # deterministic per-worker slowdown
+                                       # factors, e.g. "3,1,1,1" — the analogue
+                                       # of the reference's contended GPU map
+                                       # `-gpu 0,0,0,1` (README.md:23-28); mode
+                                       # taken from fault_mode; "" = off
     precision: str = "float32"         # "float32" | "bfloat16" compute dtype
     data_dir: str = "./data"
     lm_data_dir: str = "./rnn_data/wikitext-2"
@@ -95,6 +114,13 @@ class Config:
                                        # kernel; NOTE: drops attention-prob
                                        # dropout (a semantics change, hence a
                                        # separate knob from use_pallas)
+    stream_chunk_steps: int = 128      # host data path streams the epoch in
+                                       # windows of this many steps (gather +
+                                       # device_put of window k+1 overlaps
+                                       # device compute of window k), bounding
+                                       # peak host memory to O(2·chunk·batch)
+                                       # instead of the whole epoch; 0 = off.
+                                       # No-op when the epoch fits one window.
     warm_start: bool = False           # pre-compile the whole bucketed batch
                                        # shape ladder before epoch 0, so DBS
                                        # rebalances never pay an XLA compile
@@ -113,6 +139,11 @@ class Config:
             raise ValueError("device map length must equal world_size")
         if self.fault_mode not in ("virtual", "compute"):
             raise ValueError("fault_mode must be 'virtual' or 'compute'")
+        if self.straggler and len(self.straggler_factors()) != self.world_size:
+            raise ValueError("straggler factor list length must equal world_size")
+
+    def straggler_factors(self) -> List[float]:
+        return [float(x) for x in self.straggler.split(",")] if self.straggler else []
 
     @property
     def num_classes(self) -> int:
@@ -174,12 +205,21 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("-de", "--disable_enhancements", type=str2bool, default=d.disable_enhancements)
     # TPU-native extras
     p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--n_train", type=int, default=d.n_train,
+                   help="Truncate the train split to N examples (LM: tokens); 0 = full.")
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--bucket", type=int, default=d.bucket)
     p.add_argument("--capacity_factor", type=float, default=d.capacity_factor)
     p.add_argument("--snap_to_bucket", type=str2bool, default=d.snap_to_bucket)
+    p.add_argument("--stream_chunk_steps", type=int, default=d.stream_chunk_steps,
+                   help="Stream the host data path in windows of N steps "
+                        "(prefetch overlaps compute); 0 = materialize whole epochs.")
     p.add_argument("--time_smoothing", type=float, default=d.time_smoothing)
     p.add_argument("--fault_mode", type=str, default=d.fault_mode, choices=["virtual", "compute"])
+    p.add_argument("--straggler", type=str, default=d.straggler,
+                   help="Deterministic per-worker slowdown factors, e.g. '3,1,1,1' "
+                        "(the reference's contended -gpu 0,0,0,1 profile); "
+                        "fault_mode picks virtual vs real injected compute.")
     p.add_argument("--precision", type=str, default=d.precision, choices=["float32", "bfloat16"])
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--lm_data_dir", type=str, default=d.lm_data_dir)

@@ -20,7 +20,7 @@ masked padding steps so synchronous combines stay aligned.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -82,20 +82,28 @@ class EpochPlan:
         st = {w.steps for w in self.workers}
         return len(bs) == 1 and len(pd) == 1 and len(st) == 1
 
-    def epoch_indices(self, rank: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Materialize worker ``rank``'s epoch as static-shape step batches.
+    def epoch_indices(
+        self, rank: int, s0: int = 0, s1: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Materialize worker ``rank``'s steps ``[s0, s1)`` as static-shape
+        step batches (defaults: the whole epoch).
 
-        Returns ``(idx, mask)`` of shape ``[num_steps, padded_batch]``: row s
-        holds the example indices of step s (zeros in padding slots) and the
-        mask marks real examples. Every owned index appears exactly once."""
+        Returns ``(idx, mask)`` of shape ``[s1-s0, padded_batch]``: row i
+        holds the example indices of step s0+i (zeros in padding slots) and
+        the mask marks real examples. Over a full sweep of the step ranges,
+        every owned index appears exactly once — the streaming host path
+        gathers bounded windows instead of whole epochs."""
         w = self.workers[rank]
-        idx = np.zeros((self.num_steps, w.padded_batch), dtype=np.int64)
-        mask = np.zeros((self.num_steps, w.padded_batch), dtype=bool)
+        if s1 is None:
+            s1 = self.num_steps
+        n = s1 - s0
+        idx = np.zeros((n, w.padded_batch), dtype=np.int64)
+        mask = np.zeros((n, w.padded_batch), dtype=bool)
         b = max(w.batch_size, 1)
-        for s in range(w.steps):
+        for i, s in enumerate(range(s0, min(s1, w.steps))):
             chunk = w.indices[s * b : (s + 1) * b]
-            idx[s, : len(chunk)] = chunk
-            mask[s, : len(chunk)] = True
+            idx[i, : len(chunk)] = chunk
+            mask[i, : len(chunk)] = True
         return idx, mask
 
 

@@ -30,27 +30,44 @@ class ModelSpec:
     input_kind: str
 
 
+def _cnn_constructor(name: str) -> Callable[..., nn.Module] | None:
+    """Family-default names match the reference switch (dbs.py:345-362);
+    explicit variants expose every constructor the reference's Net/ files
+    define (Net/Resnet.py:91-108, Net/Densenet.py:87-100, Net/RegNet.py:108-141)."""
+    from dynamic_load_balance_distributeddnn_tpu.models import (
+        densenet,
+        googlenet,
+        mnistnet,
+        regnet,
+        resnet,
+    )
+
+    table = {
+        "mnistnet": mnistnet.MnistNet,
+        "resnet": resnet.ResNet101,
+        "resnet18": resnet.ResNet18,
+        "resnet34": resnet.ResNet34,
+        "resnet50": resnet.ResNet50,
+        "resnet101": resnet.ResNet101,
+        "resnet152": resnet.ResNet152,
+        "densenet": densenet.DenseNet121,
+        "densenet121": densenet.DenseNet121,
+        "densenet169": densenet.DenseNet169,
+        "densenet201": densenet.DenseNet201,
+        "densenet161": densenet.DenseNet161,
+        "googlenet": googlenet.GoogLeNet,
+        "regnet": regnet.RegNetY_400MF,
+        "regnetx200mf": regnet.RegNetX_200MF,
+        "regnetx400mf": regnet.RegNetX_400MF,
+        "regnety400mf": regnet.RegNetY_400MF,
+    }
+    return table.get(name)
+
+
 def build_model(name: str, num_classes: int = 10, **kw) -> ModelSpec:
-    if name == "mnistnet":
-        from dynamic_load_balance_distributeddnn_tpu.models.mnistnet import MnistNet
-
-        return ModelSpec(name, MnistNet(num_classes=num_classes), "logits", "image")
-    if name == "resnet":
-        from dynamic_load_balance_distributeddnn_tpu.models.resnet import ResNet101
-
-        return ModelSpec(name, ResNet101(num_classes=num_classes), "logits", "image")
-    if name == "densenet":
-        from dynamic_load_balance_distributeddnn_tpu.models.densenet import DenseNet121
-
-        return ModelSpec(name, DenseNet121(num_classes=num_classes), "logits", "image")
-    if name == "googlenet":
-        from dynamic_load_balance_distributeddnn_tpu.models.googlenet import GoogLeNet
-
-        return ModelSpec(name, GoogLeNet(num_classes=num_classes), "logits", "image")
-    if name == "regnet":
-        from dynamic_load_balance_distributeddnn_tpu.models.regnet import RegNetY_400MF
-
-        return ModelSpec(name, RegNetY_400MF(num_classes=num_classes), "logits", "image")
+    ctor = _cnn_constructor(name)
+    if ctor is not None:
+        return ModelSpec(name, ctor(num_classes=num_classes), "logits", "image")
     if name == "transformer":
         from dynamic_load_balance_distributeddnn_tpu.models.transformer import (
             TransformerLM,

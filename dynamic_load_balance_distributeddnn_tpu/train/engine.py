@@ -24,6 +24,7 @@ enters the solver's time vector — the reference's compute/comm split contract
 
 from __future__ import annotations
 
+import concurrent.futures
 import time
 from typing import Dict, List, Optional
 
@@ -51,6 +52,7 @@ from dynamic_load_balance_distributeddnn_tpu.faults import (
     FaultInjector,
     LuckyFaultInjector,
     NullInjector,
+    StaticStragglerInjector,
 )
 from dynamic_load_balance_distributeddnn_tpu.models import build_model
 from dynamic_load_balance_distributeddnn_tpu.obs import MetricsRecorder, init_logger
@@ -151,6 +153,10 @@ class Trainer:
 
         if injector is not None:
             self.injector = injector
+        elif cfg.straggler:
+            self.injector = StaticStragglerInjector(
+                cfg.straggler_factors(), mode=cfg.fault_mode
+            )
         elif cfg.fault_tolerance:
             self.injector = LuckyFaultInjector(
                 cfg.world_size,
@@ -188,8 +194,9 @@ class Trainer:
     def _setup_data(self, bundle: Optional[DatasetBundle]) -> None:
         cfg = self.cfg
         if bundle is None:
-            n_cap = 2048 if cfg.debug else None
-            bundle = load_dataset(cfg.dataset, cfg.data_dir, n_train=n_cap, n_test=n_cap)
+            n_cap = cfg.n_train or (2048 if cfg.debug else None)
+            n_test = 2048 if cfg.debug else None
+            bundle = load_dataset(cfg.dataset, cfg.data_dir, n_train=n_cap, n_test=n_test)
         self.bundle = bundle
         self.n_train = len(bundle.train_x)
         if bundle.synthetic:
@@ -491,17 +498,30 @@ class Trainer:
             and not self._needs_iter_cost
         )
 
-    def _train_epoch_fused(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:
-        cfg = self.cfg
-        self.timekeeper.reset()
-        # [steps, ws*b_pad, ...] global layout: worker r owns slice r; each
-        # process materializes only its own workers' slice.
+    def _chunk_ranges(self, num_steps: int):
+        """Step windows of the streaming host path: ``stream_chunk_steps``-sized
+        windows (0 = one whole-epoch window). At most two distinct window
+        lengths per epoch (body + tail), so the fused scan compiles at most
+        twice per geometry."""
+        chunk = self.cfg.stream_chunk_steps
+        if chunk <= 0 or num_steps <= chunk:
+            return [(0, num_steps)]
+        return [(s, min(s + chunk, num_steps)) for s in range(0, num_steps, chunk)]
+
+    def _gather_fused_window(self, plan, s0: int, s1: int):
+        """Host-side gather of steps [s0, s1): [n, ws*b_pad, ...] numpy arrays
+        in the fused path's global layout (worker r owns slice r; each process
+        materializes only its own workers' slice)."""
         data = [
-            self._worker_inputs(plan, self.rank_lo + r) for r in range(self.ws_local)
+            self._worker_inputs(plan, self.rank_lo + r, s0, s1)
+            for r in range(self.ws_local)
         ]
         xs = np.concatenate([d[0] for d in data], axis=1)
         ys = np.concatenate([d[1] for d in data], axis=1)
         ws_ = np.concatenate([d[2] for d in data], axis=1)
+        return xs, ys, ws_
+
+    def _put_fused_window(self, xs, ys, ws_):
         from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
 
         mesh = self.mesh
@@ -509,10 +529,6 @@ class Trainer:
             xs = jax.device_put(xs, batch_sharding(mesh, xs.ndim, axis_dim=1))
             ys = jax.device_put(ys, batch_sharding(mesh, ys.ndim, axis_dim=1))
             ws_ = jax.device_put(ws_, batch_sharding(mesh, ws_.ndim, axis_dim=1))
-            slow = jax.device_put(
-                faults.slow_iters_per_step.astype(np.int32),
-                batch_sharding(mesh, 1),
-            )
         else:
             xs = jax.make_array_from_process_local_data(
                 batch_sharding(mesh, xs.ndim, axis_dim=1), xs
@@ -523,18 +539,54 @@ class Trainer:
             ws_ = jax.make_array_from_process_local_data(
                 batch_sharding(mesh, ws_.ndim, axis_dim=1), ws_
             )
+        return xs, ys, ws_
+
+    def _train_epoch_fused(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:
+        cfg = self.cfg
+        self.timekeeper.reset()
+        from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
+
+        mesh = self.mesh
+        if self.n_proc == 1:
+            slow = jax.device_put(
+                faults.slow_iters_per_step.astype(np.int32),
+                batch_sharding(mesh, 1),
+            )
+        else:
             slow = jax.make_array_from_process_local_data(
                 batch_sharding(mesh, 1),
                 faults.slow_iters_per_step.astype(np.int32)[
                     self.rank_lo : self.rank_lo + self.ws_local
                 ],
             )
-        self.state, metrics = self.steps.fused_epoch(
-            self.state, xs, ys, ws_, slow, jnp.int32(cfg.seed * 31 + epoch)
-        )
-        metrics = np.asarray(jax.block_until_ready(metrics))
+        seed = jnp.int32(cfg.seed * 31 + epoch)
+
+        # Streaming: gather window k+1 on the prefetch thread while the device
+        # runs window k (dispatch is async — the jit call returns immediately).
+        # The per-step dropout/augment rng folds in state.step, not the scan
+        # index, so windowed scans are bitwise-identical to one whole-epoch
+        # scan. Peak host memory: two windows, not the epoch.
+        ranges = self._chunk_ranges(plan.num_steps)
+        metrics_total = np.zeros(4, dtype=np.float64)
+        first_window = None
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+            fut = pool.submit(self._gather_fused_window, plan, *ranges[0])
+            for i, _ in enumerate(ranges):
+                xs, ys, ws_ = self._put_fused_window(*fut.result())
+                if i + 1 < len(ranges):
+                    fut = pool.submit(self._gather_fused_window, plan, *ranges[i + 1])
+                if first_window is None and self._fused_sync_per_step is None:
+                    # retained only on the run's first epoch, for the one-time
+                    # sync/FLOPs probes below — not pinned on later epochs
+                    first_window = (xs, ys, ws_)
+                self.state, metrics = self.steps.fused_epoch(
+                    self.state, xs, ys, ws_, slow, seed
+                )
+                metrics_total += np.asarray(jax.block_until_ready(metrics))
+        metrics = metrics_total
         probe_overhead = 0.0
         if self._fused_sync_per_step is None:
+            xs, ys, ws_ = first_window
             t0 = time.perf_counter()
             self._fused_sync_per_step = self._probe_fused_sync(
                 xs, ys, ws_, slow, jnp.int32(cfg.seed * 31 + epoch)
@@ -600,14 +652,15 @@ class Trainer:
         delta = t_full - t_local
         return float(delta) if delta > 0.0 else float(t_psum)
 
-    def _worker_inputs(self, plan, rank: int):
-        """Materialize one worker's epoch: [steps, b_pad, ...] batches, labels
-        and per-example weights (the weighted-combine contract). The gather
-        runs through the native C++ runtime when available (multithreaded
-        row pack; runtime/native.py), numpy otherwise — identical results."""
+    def _worker_inputs(self, plan, rank: int, s0: int = 0, s1: Optional[int] = None):
+        """Materialize one worker's steps [s0, s1) (default: the whole epoch):
+        [n, b_pad, ...] batches, labels and per-example weights (the
+        weighted-combine contract). The gather runs through the native C++
+        runtime when available (multithreaded row pack; runtime/native.py),
+        numpy otherwise — identical results."""
         from dynamic_load_balance_distributeddnn_tpu.runtime import take_rows
 
-        idx, mask = plan.epoch_indices(rank)
+        idx, mask = plan.epoch_indices(rank, s0, s1)
         x = take_rows(self.bundle.train_x, idx)
         y = take_rows(self.bundle.train_y, idx)
         w = np.stack(
@@ -619,7 +672,7 @@ class Trainer:
                     world_size=self.cfg.world_size,
                     uniform_worker_weight=self.cfg.disable_enhancements,
                 )
-                for s in range(plan.num_steps)
+                for s in range(mask.shape[0])
             ]
         )
         return x, y, w
@@ -630,9 +683,6 @@ class Trainer:
         self.timekeeper.reset()
 
         # Local topo ranks r (0..ws_local-1) own global worker rank_lo + r.
-        data = [
-            self._worker_inputs(plan, self.rank_lo + r) for r in range(self.ws_local)
-        ]
         groups = topo.groups
         dev_order = topo.used_device_indices
         aux_acc: List = []
@@ -640,41 +690,64 @@ class Trainer:
         base_key = jax.random.PRNGKey(cfg.seed * 7919 + epoch)
         wkeys = jax.random.split(base_key, cfg.world_size * max(plan.num_steps, 1))
 
-        for s in range(plan.num_steps):
-            partials = {}
-            staged = {}
-            for d in dev_order:
-                dev = topo.devices[d]
-                for r in groups[d]:
-                    x, y, w = data[r]
-                    gr = self.rank_lo + r
-                    staged[r] = (
-                        jax.device_put(x[s], dev),
-                        jax.device_put(y[s], dev),
-                        jax.device_put(w[s], dev),
-                        jax.device_put(wkeys[s * cfg.world_size + gr], dev),
-                        jax.device_put(
-                            jnp.int32(faults.slow_iters_per_step[gr]), dev
-                        ),
-                    )
-            views = shard_views(self.state.params, self.topology.devices)
-            for d in dev_order:
-                acc = None
-                for r in groups[d]:
-                    xs, ys, ws_, key, slow = staged[r]
-                    if acc is None:
-                        acc, aux = self.steps.worker_step_first(
-                            views[d], xs, ys, ws_, key, slow
-                        )
-                    else:
-                        acc, aux = self.steps.worker_step_acc(
-                            views[d], acc, xs, ys, ws_, key, slow
-                        )
-                    aux_acc.append(aux)
-                partials[d] = acc
+        def gather_window(s0: int, s1: int):
+            return [
+                self._worker_inputs(plan, self.rank_lo + r, s0, s1)
+                for r in range(self.ws_local)
+            ]
 
-            stacked = stack_partials([partials[d] for d in dev_order], self.mesh)
-            self.state = self.steps.combine_update(self.state, stacked)
+        # Streaming host path: window k+1 gathers on the prefetch thread while
+        # window k's steps dispatch (async). Window-local rows, absolute-step
+        # rng keys — identical math to the whole-epoch gather.
+        ranges = self._chunk_ranges(plan.num_steps)
+        first_data = None
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+            fut = pool.submit(gather_window, *ranges[0])
+            for i, (w0, w1) in enumerate(ranges):
+                data = fut.result()
+                if i + 1 < len(ranges):
+                    fut = pool.submit(gather_window, *ranges[i + 1])
+                if first_data is None:
+                    first_data = data
+                for s_abs in range(w0, w1):
+                    s = s_abs - w0
+                    partials = {}
+                    staged = {}
+                    for d in dev_order:
+                        dev = topo.devices[d]
+                        for r in groups[d]:
+                            x, y, w = data[r]
+                            gr = self.rank_lo + r
+                            staged[r] = (
+                                jax.device_put(x[s], dev),
+                                jax.device_put(y[s], dev),
+                                jax.device_put(w[s], dev),
+                                jax.device_put(wkeys[s_abs * cfg.world_size + gr], dev),
+                                jax.device_put(
+                                    jnp.int32(faults.slow_iters_per_step[gr]), dev
+                                ),
+                            )
+                    views = shard_views(self.state.params, self.topology.devices)
+                    for d in dev_order:
+                        acc = None
+                        for r in groups[d]:
+                            xs, ys, ws_, key, slow = staged[r]
+                            if acc is None:
+                                acc, aux = self.steps.worker_step_first(
+                                    views[d], xs, ys, ws_, key, slow
+                                )
+                            else:
+                                acc, aux = self.steps.worker_step_acc(
+                                    views[d], acc, xs, ys, ws_, key, slow
+                                )
+                            aux_acc.append(aux)
+                        partials[d] = acc
+
+                    stacked = stack_partials(
+                        [partials[d] for d in dev_order], self.mesh
+                    )
+                    self.state = self.steps.combine_update(self.state, stacked)
+        data = first_data  # probes below reuse the first window's batches
 
         jax.block_until_ready(self.state.params)
         # Probe AFTER the epoch's async pipeline has drained, so per-worker

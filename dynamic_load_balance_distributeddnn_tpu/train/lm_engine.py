@@ -61,7 +61,9 @@ class LMTrainer(Trainer):
         for note in getattr(self.corpus, "notes", []):
             self.logger.warning(f"corpus: {note}")
         stream = self.corpus.train
-        if cfg.debug and len(stream) > 60_000:
+        if cfg.n_train:
+            stream = stream[: cfg.n_train]
+        elif cfg.debug and len(stream) > 60_000:
             stream = stream[:60_000]
         self.train_stream = stream
         self.n_train = len(stream)
@@ -145,7 +147,7 @@ class LMTrainer(Trainer):
             global_batch=cfg.batch_size,
         )
 
-    def _worker_inputs(self, plan: EpochPlan, rank: int):
+    def _worker_inputs(self, plan: EpochPlan, rank: int, s0: int = 0, s1=None):
         cfg = self.cfg
         w = plan.workers[rank]
         if len(w.indices):
@@ -170,7 +172,12 @@ class LMTrainer(Trainer):
         weights = m * (
             p_r / np.maximum(tok_counts, 1.0)[:, None, None]
         ).astype(np.float32)
-        return x, y, weights
+        # Streaming window slice: token windows derive from the (small) folded
+        # stream, so the LM builds them all and returns the requested rows —
+        # the step-range contract without image-scale memory concerns.
+        if s1 is None:
+            s1 = plan.num_steps
+        return x[s0:s1], y[s0:s1], weights[s0:s1]
 
     # ------------------------------------------------------------- validate
 

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Generate the BASELINE.md acceptance-config statis artifacts.
+
+Runs the 5 acceptance configs (BASELINE.md §"Acceptance configs"), each with
+dbs on AND off (the A/B of the reference's run.sh:25-41), through the REAL
+entry point (``cli.main`` — the analogue of ``python dbs.py <flags>``,
+dbs.py:527-544), producing the 9-series ``.npy``/``.json`` recorder artifacts
+per run (mirroring dbs.py:440-442) under ``--out_dir``.
+
+Straggler profiles are induced deterministically with ``--straggler`` (the
+analogue of the reference README's contended GPU map ``-gpu 0,0,0,1``,
+README.md:23-28) in ``compute`` mode: real extra device FLOPs, so the
+balancer reacts to genuinely measured time.
+
+Scale knobs (env): STATIS_NTRAIN (vision examples, default 4096),
+STATIS_LM_NTRAIN (LM tokens, default 120000), STATIS_EPOCHS (default 6),
+STATIS_CPU=1 (force the 8-virtual-device CPU mesh — the reference's
+gloo-on-localhost debug analogue), STATIS_ONLY (comma list of config names
+to run, e.g. "c3_densenet"). Real data is used when present under ./data //
+./rnn_data (run data/prepare.py first); otherwise the synthetic stand-ins.
+
+Usage: python scripts/gen_statis.py [--out_dir artifacts/acceptance]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+if os.environ.get("STATIS_CPU") == "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NTRAIN = int(os.environ.get("STATIS_NTRAIN", 4096))
+LM_NTRAIN = int(os.environ.get("STATIS_LM_NTRAIN", 120_000))
+EPOCHS = int(os.environ.get("STATIS_EPOCHS", 6))
+
+# name -> cli args (without -dbs; both arms added by the driver loop below).
+# ocp on for the CNN sweep legs, as run.sh:25-41 does.
+CONFIGS = {
+    # 1. MnistNet / FashionMNIST, 2-worker, debug-mode scale (BASELINE #1)
+    "c1_mnistnet": [
+        "-d", "true", "-ws", "2", "-b", "128", "-m", "mnistnet", "-ds", "mnist",
+        "--straggler", "3,1",
+    ],
+    # 2. ResNet-18 / CIFAR-10, 4-worker, balanced workers (BASELINE #2)
+    "c2_resnet18": [
+        "-d", "false", "-ws", "4", "-b", "512", "-m", "resnet18", "-ds", "cifar10",
+        "-ocp", "true",
+    ],
+    # 3. DenseNet-121 / CIFAR-10, 4-worker, 3:1 straggler — the README recipe
+    #    (BASELINE #3, north star)
+    "c3_densenet": [
+        "-d", "false", "-ws", "4", "-b", "512", "-m", "densenet", "-ds", "cifar10",
+        "-ocp", "true", "--straggler", "3,1,1,1",
+    ],
+    # 4. RegNet / CIFAR-10, 8-worker heterogeneous mix (BASELINE #4)
+    "c4_regnet_ws8": [
+        "-d", "false", "-ws", "8", "-b", "512", "-m", "regnet", "-ds", "cifar10",
+        "-ocp", "true", "--straggler", "3,2,1,1,1,1,1,1",
+    ],
+    # 5. Transformer LM / wikitext-2, 4-worker (BASELINE #5)
+    "c5_transformer": [
+        "-d", "false", "-ws", "4", "-b", "80", "-m", "transformer", "-ds", "wikitext2",
+        "--bptt", "35", "--grad_clip", "0.25", "--bucket", "4",
+        "--straggler", "3,1,1,1",
+    ],
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out_dir", default="artifacts/acceptance")
+    ns = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("STATIS_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")  # beats the axon TPU plugin
+
+    from dynamic_load_balance_distributeddnn_tpu import cli
+
+    stat_dir = os.path.join(ns.out_dir, "statis")
+    log_dir = os.path.join(ns.out_dir, "logs")
+    os.makedirs(stat_dir, exist_ok=True)
+
+    only = os.environ.get("STATIS_ONLY")
+    names = [n for n in CONFIGS if not only or n in only.split(",")]
+    manifest = {
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        "ntrain": NTRAIN,
+        "lm_ntrain": LM_NTRAIN,
+        "epochs": EPOCHS,
+        "runs": {},
+    }
+    for name in names:
+        base = CONFIGS[name]
+        n_train = LM_NTRAIN if name == "c5_transformer" else NTRAIN
+        for dbs in ("true", "false"):
+            args = base + [
+                "-dbs", dbs,
+                "-e", str(EPOCHS),
+                "--n_train", str(n_train),
+                "--fault_mode", "compute",
+                "--warm_start", "true",
+                "--stat_dir", stat_dir,
+                "--log_dir", log_dir,
+            ]
+            t0 = time.time()
+            print(f"[gen_statis] {name} dbs={dbs}: cli.main({' '.join(args)})", flush=True)
+            rc = cli.main(args)
+            manifest["runs"][f"{name}_dbs{dbs}"] = {
+                "rc": rc,
+                "wall_s": round(time.time() - t0, 1),
+                "args": args,
+            }
+            with open(os.path.join(ns.out_dir, "manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=2)
+            if rc != 0:
+                print(f"[gen_statis] {name} dbs={dbs} FAILED rc={rc}", file=sys.stderr)
+                return rc
+    print("[gen_statis] all runs complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Pallas-vs-XLA kernel microbenchmark on the real chip (VERDICT #3).
+
+For each custom kernel (ops/pallas/: flash attention, fused GroupNorm, fused
+softmax-xent) and each shape the model zoo actually uses — plus the
+long-sequence shapes ring attention targets — time the jitted forward and
+forward+grad against the plain-XLA equivalent the kernel would replace
+(the reference delegates these to cuDNN, SURVEY §2.2; here the alternative
+is stock XLA fusion).
+
+Writes artifacts/kernel_bench_<platform>.json and a markdown table to
+artifacts/KERNELS.md. The use_pallas / use_flash_attention config defaults
+are chosen from (and justified by) this table.
+
+Usage: python scripts/kernel_bench.py [--repeats 30] [--quick]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from dynamic_load_balance_distributeddnn_tpu.ops.losses import per_example_cross_entropy
+from dynamic_load_balance_distributeddnn_tpu.ops.pallas.flash_attention import flash_attention
+from dynamic_load_balance_distributeddnn_tpu.ops.pallas.groupnorm import fused_group_norm
+from dynamic_load_balance_distributeddnn_tpu.ops.pallas.xent import fused_softmax_xent
+
+
+def timeit(fn, *args, repeats=30):
+    """Median wall of a jitted call, post-warmup, fully fenced."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    walls = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        walls.append(time.perf_counter() - t0)
+    return statistics.median(walls)
+
+
+# ------------------------------------------------------------ XLA baselines
+
+
+def xla_attention(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+def xla_group_norm(x, scale, bias, groups, eps=1e-6):
+    shape = x.shape
+    c = shape[-1]
+    xg = x.reshape(shape[0], -1, groups, c // groups).astype(jnp.float32)
+    mean = xg.mean(axis=(1, 3), keepdims=True)
+    var = ((xg - mean) ** 2).mean(axis=(1, 3), keepdims=True)
+    y = (xg - mean) / jnp.sqrt(var + eps)
+    y = y.reshape(shape[0], -1, c) * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.reshape(shape).astype(x.dtype)
+
+
+# ------------------------------------------------------------ benchmark legs
+
+
+def bench_attention(results, dtype, repeats, quick):
+    """LM shapes: the reference transformer is T=35 bptt, 2 heads, d=100
+    (dbs.py:337-343); ring/long-context targets go to 4k."""
+    shapes = [(40, 2, 64, 128), (8, 2, 512, 128), (4, 4, 2048, 128)]
+    if not quick:
+        shapes.append((2, 4, 4096, 128))
+    for b, h, t, d in shapes:
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (b, h, t, d), dtype)
+        k = jax.random.normal(kk, (b, h, t, d), dtype)
+        v = jax.random.normal(kv, (b, h, t, d), dtype)
+
+        for causal in (True,):
+            pall = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal, interpret=False))
+            base = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal))
+            pall_g = jax.jit(jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=causal, interpret=False).sum(), argnums=(0, 1, 2)))
+            base_g = jax.jit(jax.grad(lambda q, k, v: xla_attention(q, k, v, causal).sum(), argnums=(0, 1, 2)))
+            row = {
+                "kernel": "flash_attention",
+                "shape": f"B{b}xH{h}xT{t}xD{d}",
+                "dtype": str(dtype.__name__),
+                "causal": causal,
+            }
+            try:
+                row["fwd_pallas_ms"] = timeit(pall, q, k, v, repeats=repeats) * 1e3
+                row["fwd_xla_ms"] = timeit(base, q, k, v, repeats=repeats) * 1e3
+                row["grad_pallas_ms"] = timeit(pall_g, q, k, v, repeats=repeats) * 1e3
+                row["grad_xla_ms"] = timeit(base_g, q, k, v, repeats=repeats) * 1e3
+            except Exception as e:  # a kernel that won't lower is a result, not a crash
+                row["error"] = f"{type(e).__name__}: {e}"[:300]
+            results.append(row)
+            print(json.dumps(row), flush=True)
+
+
+def bench_groupnorm(results, dtype, repeats, quick):
+    """CNN shapes: 32x32 CIFAR maps through the zoo's widths, GroupNorm(32)
+    (Net/Resnet.py:11-13); batch = per-worker 128 of the B=512/ws=4 recipe."""
+    shapes = [(128, 32, 32, 64), (128, 16, 16, 256), (128, 8, 8, 512)]
+    if not quick:
+        shapes.append((256, 32, 32, 128))
+    for b, hh, ww, c in shapes:
+        groups = 32
+        kx, ks = jax.random.split(jax.random.PRNGKey(1))
+        x = jax.random.normal(kx, (b, hh, ww, c), dtype)
+        scale = jax.random.normal(ks, (c,), jnp.float32)
+        bias = jnp.zeros((c,), jnp.float32)
+
+        pall = jax.jit(lambda x, s, b_: fused_group_norm(x, s, b_, groups, interpret=False))
+        base = jax.jit(lambda x, s, b_: xla_group_norm(x, s, b_, groups))
+        pall_g = jax.jit(jax.grad(lambda x, s, b_: fused_group_norm(x, s, b_, groups, interpret=False).sum(), argnums=(0, 1, 2)))
+        base_g = jax.jit(jax.grad(lambda x, s, b_: xla_group_norm(x, s, b_, groups).sum(), argnums=(0, 1, 2)))
+        row = {
+            "kernel": "fused_group_norm",
+            "shape": f"B{b}x{hh}x{ww}xC{c}/g{groups}",
+            "dtype": str(dtype.__name__),
+        }
+        try:
+            row["fwd_pallas_ms"] = timeit(pall, x, scale, bias, repeats=repeats) * 1e3
+            row["fwd_xla_ms"] = timeit(base, x, scale, bias, repeats=repeats) * 1e3
+            row["grad_pallas_ms"] = timeit(pall_g, x, scale, bias, repeats=repeats) * 1e3
+            row["grad_xla_ms"] = timeit(base_g, x, scale, bias, repeats=repeats) * 1e3
+        except Exception as e:
+            row["error"] = f"{type(e).__name__}: {e}"[:300]
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+
+def bench_xent(results, dtype, repeats, quick):
+    """Loss shapes: CIFAR [B,10/100] and the LM's [B*bptt, V=33278]
+    (dbs.py:270, 337)."""
+    shapes = [(512, 10), (512, 100), (700, 33278)]
+    if not quick:
+        shapes.append((2800, 33278))
+    for r, v in shapes:
+        kx, kl = jax.random.split(jax.random.PRNGKey(2))
+        logits = jax.random.normal(kx, (r, v), dtype)
+        labels = jax.random.randint(kl, (r,), 0, v)
+
+        pall = jax.jit(lambda lg, lb: fused_softmax_xent(lg, lb, interpret=False).sum())
+        base = jax.jit(lambda lg, lb: per_example_cross_entropy(lg, lb).sum())
+        pall_g = jax.jit(jax.grad(lambda lg, lb: fused_softmax_xent(lg, lb, interpret=False).sum(), argnums=0))
+        base_g = jax.jit(jax.grad(lambda lg, lb: per_example_cross_entropy(lg, lb).sum(), argnums=0))
+        row = {"kernel": "fused_softmax_xent", "shape": f"R{r}xV{v}", "dtype": str(dtype.__name__)}
+        try:
+            row["fwd_pallas_ms"] = timeit(pall, logits, labels, repeats=repeats) * 1e3
+            row["fwd_xla_ms"] = timeit(base, logits, labels, repeats=repeats) * 1e3
+            row["grad_pallas_ms"] = timeit(pall_g, logits, labels, repeats=repeats) * 1e3
+            row["grad_xla_ms"] = timeit(base_g, logits, labels, repeats=repeats) * 1e3
+        except Exception as e:
+            row["error"] = f"{type(e).__name__}: {e}"[:300]
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+
+def to_markdown(results, platform, kind):
+    lines = [
+        f"# Kernel microbenchmarks — {platform} ({kind})",
+        "",
+        "Median jitted wall (ms), post-warmup, `block_until_ready`-fenced.",
+        "`speedup` = XLA / Pallas (>1 means the Pallas kernel wins).",
+        "Generated by `scripts/kernel_bench.py`.",
+        "",
+        "| kernel | shape | dtype | fwd pallas | fwd xla | fwd speedup | grad pallas | grad xla | grad speedup |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in results:
+        if "error" in r:
+            lines.append(
+                f"| {r['kernel']} | {r['shape']} | {r['dtype']} | ERROR: {r['error'][:80]} | | | | | |"
+            )
+            continue
+        fs = r["fwd_xla_ms"] / r["fwd_pallas_ms"]
+        gs = r["grad_xla_ms"] / r["grad_pallas_ms"]
+        lines.append(
+            f"| {r['kernel']} | {r['shape']} | {r['dtype']} "
+            f"| {r['fwd_pallas_ms']:.3f} | {r['fwd_xla_ms']:.3f} | {fs:.2f}x "
+            f"| {r['grad_pallas_ms']:.3f} | {r['grad_xla_ms']:.3f} | {gs:.2f}x |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repeats", type=int, default=30)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    ap.add_argument("--out_dir", default="artifacts")
+    ns = ap.parse_args()
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    kind = getattr(dev, "device_kind", "?")
+    print(f"[kernel_bench] {platform}/{kind}", flush=True)
+    dtype = jnp.bfloat16 if ns.dtype == "bfloat16" else jnp.float32
+
+    results = []
+    bench_attention(results, dtype, ns.repeats, ns.quick)
+    bench_groupnorm(results, dtype, ns.repeats, ns.quick)
+    bench_xent(results, dtype, ns.repeats, ns.quick)
+
+    os.makedirs(ns.out_dir, exist_ok=True)
+    payload = {"platform": platform, "device_kind": kind, "dtype": ns.dtype, "results": results}
+    with open(os.path.join(ns.out_dir, f"kernel_bench_{platform}.json"), "w") as f:
+        json.dump(payload, f, indent=2)
+    with open(os.path.join(ns.out_dir, "KERNELS.md"), "w") as f:
+        f.write(to_markdown(results, platform, kind))
+    print(f"[kernel_bench] wrote {ns.out_dir}/kernel_bench_{platform}.json")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

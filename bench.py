@@ -140,6 +140,11 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
         dataset = "cifar10"
     epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
     ws = int(os.environ.get("BENCH_WS", 4))
+    # bf16 compute + f32 master weights: the MXU's native dtype (fp32 convs
+    # forfeit most of the systolic array's throughput on v5e). Justified by
+    # the MFU instrumentation — see artifacts/PRECISION.md; BENCH_PRECISION
+    # flips the A/B.
+    precision = os.environ.get("BENCH_PRECISION", "bfloat16")
     bundle = load_dataset(dataset, n_train=n_train, n_test=512)
     factors = [3.0] + [1.0] * (ws - 1)
 
@@ -190,6 +195,7 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
             fault_tolerance=True,
             fault_mode="compute",
             bucket=bucket,
+            precision=precision,
             # pre-compile the bucketed shape ladder so rebalance epochs never
             # pay an XLA compile inside a timed wall (the balancer's win would
             # otherwise drown in compile noise on short runs)

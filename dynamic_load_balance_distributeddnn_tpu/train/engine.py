@@ -175,6 +175,10 @@ class Trainer:
         self.shares = initial_partition(cfg.world_size)
         self.node_times = np.ones(cfg.world_size, dtype=np.float64)
         self.per_example_cost = np.full(cfg.world_size, np.nan)
+        # In-step cost of one synthetic-load iteration: seeded from the
+        # standalone calibration, then closed-loop-corrected from realized
+        # probe deltas (per-process — hosts may genuinely differ).
+        self._iter_cost_s: Optional[float] = None
         self.timekeeper = TimeKeeper(cfg.world_size)
         self.total_wallclock = 0.0
         # Fused-path sync-time meter: seconds of collective cost per step,
@@ -397,7 +401,11 @@ class Trainer:
 
         ctx = FaultContext(
             batch_sizes=plan.batch_sizes.astype(np.float64),
-            iter_cost_s=calibrate_iter_cost() if self._needs_iter_cost else None,
+            iter_cost_s=(
+                (self._iter_cost_s or calibrate_iter_cost())
+                if self._needs_iter_cost
+                else None
+            ),
             per_example_cost_s=(
                 self.per_example_cost if np.isfinite(self.per_example_cost).all() else None
             ),
@@ -859,10 +867,28 @@ class Trainer:
                     dt = min(dt, time.perf_counter() - t0)
                 w_plan = plan.workers[gr]
                 self.timekeeper.add_compute(gr, dt * w_plan.steps)
-                clean = dt - float(faults.slow_iters_per_step[gr]) * (
-                    calibrate_iter_cost() if self._needs_iter_cost else 0.0
-                )
-                self.per_example_cost[gr] = max(clean, 1e-9) / max(w_plan.batch_size, 1)
+                slow_n = float(faults.slow_iters_per_step[gr])
+                if np.isnan(self.per_example_cost[gr]):
+                    # First (injection-free) measurement IS the clean cost;
+                    # it stays frozen. Re-deriving it every epoch by
+                    # subtracting estimated injected cost is a positive
+                    # feedback loop: any underestimate of the in-step
+                    # iteration cost inflates "clean", which inflates next
+                    # epoch's injection, without bound.
+                    self.per_example_cost[gr] = max(dt, 1e-9) / max(
+                        w_plan.batch_size, 1
+                    )
+                elif slow_n > 0:
+                    # Closed-loop iteration-cost calibration: the standalone
+                    # calibrated cost can differ from the in-step cost (e.g.
+                    # shared host thread pools on the CPU mesh); the realized
+                    # cost (measured minus frozen clean, per iter) converges
+                    # injection to the requested factors on any backend.
+                    clean = self.per_example_cost[gr] * w_plan.batch_size
+                    realized = (dt - clean) / slow_n
+                    if realized > 0 and np.isfinite(realized):
+                        prev = self._iter_cost_s or realized
+                        self._iter_cost_s = 0.5 * prev + 0.5 * realized
             partials[d] = acc
         stacked = stack_partials(
             [partials[d] for d in topo.used_device_indices], self.mesh

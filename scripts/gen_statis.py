@@ -107,7 +107,10 @@ def main() -> int:
                 "-e", str(EPOCHS),
                 "--n_train", str(n_train),
                 "--fault_mode", "compute",
-                "--warm_start", "true",
+                # warm_start pre-compiles the shape ladder — worth it on TPU
+                # (cached, fast), prohibitive on the CPU mesh. The balancer's
+                # signal is compile-free either way (probe warm pass).
+                "--warm_start", os.environ.get("STATIS_WARM", "false"),
                 "--stat_dir", stat_dir,
                 "--log_dir", log_dir,
             ]

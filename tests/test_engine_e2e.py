@@ -228,3 +228,21 @@ def test_e2e_eight_workers_heterogeneous_map(bundle, tmp_path):
     assert final[0] < 1 / 8 and final[1] < 1 / 8
     assert final[2:].min() >= 1 / 8
     assert final[2:].mean() > 1 / 8
+
+
+def test_e2e_bfloat16_mixed_precision(bundle, tmp_path):
+    """bf16 compute + f32 master weights (the TPU MXU's native dtype, used by
+    bench.py): training must run and reduce loss like the f32 path, and the
+    master params must stay f32."""
+    import jax
+    import jax.numpy as jnp
+
+    tr = make_trainer(
+        bundle, stat_dir=str(tmp_path), epoch_size=2, precision="bfloat16"
+    )
+    rec = tr.run()
+    losses = rec.data["train_loss"]
+    assert len(losses) == 2 and np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 1.2
+    for leaf in jax.tree_util.tree_leaves(tr.state.params):
+        assert leaf.dtype == jnp.float32

@@ -60,3 +60,44 @@ def test_partition_shifts_on_measured_time(tmp_path):
     nt = np.array(rec.data["node_time"])
     peak = nt[2] if nt.shape[0] > 2 else nt[-1]  # after injection, before full rebalance
     assert peak[0] > peak[1:].mean(), f"worker 0 not measurably slower: {nt}"
+
+
+def test_compute_injection_magnitude_converges(tmp_path):
+    """The injected slowdown must realize the REQUESTED factor, not a
+    runaway: with dbs off (uniform batches), worker 0's measured node time
+    must settle near 3x the others. Guards the closed-loop iteration-cost
+    calibration (engine._iter_cost_s) and the frozen clean per-example cost —
+    re-deriving "clean" by subtracting estimated injection each epoch
+    diverges without bound when the standalone calibration is off (badly so
+    on the CPU mesh's shared thread pool)."""
+    ws = 4
+    cfg = Config(
+        debug=True,
+        world_size=ws,
+        batch_size=128,
+        learning_rate=0.05,
+        epoch_size=5,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=False,
+        fault_tolerance=True,
+        fault_mode="compute",
+        seed=99,
+        bucket=8,
+        stat_dir=str(tmp_path),
+    )
+    tr = Trainer(
+        cfg,
+        bundle=synthetic_dataset("mnist", n_train=1024, n_test=128),
+        injector=StaticStragglerInjector([3.0, 1.0, 1.0, 1.0], mode="compute"),
+        log_to_file=False,
+    )
+    rec = tr.run()
+    nt = np.array(rec.data["node_time"])
+    # epoch 0: calibration (no injection). epoch 1: first injection, seeded
+    # from the standalone estimate (may miss). epochs 3-4: the closed loop
+    # has realized-cost feedback -> the ratio must be near 3, not 20+.
+    ratios = nt[:, 0] / nt[:, 1:].mean(axis=1)
+    settled = ratios[3:]
+    assert np.all(settled > 1.8), f"injection too weak: {ratios}"
+    assert np.all(settled < 5.0), f"injection runaway: {ratios}"

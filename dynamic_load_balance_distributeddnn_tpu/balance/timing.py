@@ -59,3 +59,62 @@ def exchange_times(local_times: np.ndarray) -> np.ndarray:
         np.asarray(local_times, dtype=np.float64)
     )
     return np.asarray(gathered).reshape(-1)
+
+
+def ring_exchange_times(local_times: np.ndarray, mesh=None) -> np.ndarray:
+    """Device-side ring all-gather of per-worker scalar times over the mesh's
+    ICI — the literal structure of the reference's isend/recv ring
+    (dbs.py:487-493: size-1 hops, each device forwarding what it received),
+    built from ``lax.ppermute``. The host ``exchange_times`` is the default
+    (8 scalars per epoch do not merit a device collective, SURVEY §5.8); this
+    exists for topology faithfulness and as the pattern to scale metadata
+    exchange on large meshes where host gathers would serialize on one
+    coordinator.
+
+    ``local_times``: [n_dev] — entry d is the time measured for the worker on
+    mesh device d. Returns the full rank-ordered [n_dev] vector, identical on
+    every device (and to the input, since every device contributes its slot).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+        DATA_AXIS,
+        data_mesh,
+    )
+
+    mesh = mesh or data_mesh()
+    n = len(mesh.devices.flat)
+    times = jnp.asarray(local_times, dtype=jnp.float32)
+
+    def ring(t_local):
+        # t_local: [1] — this device's scalar. Accumulate into slot idx of a
+        # local [n] buffer, then forward the received value around the ring
+        # n-1 times (dbs.py:487-493's loop, one ppermute per hop).
+        idx = jax.lax.axis_index(DATA_AXIS)
+        out = jnp.zeros((n,), jnp.float32).at[idx].set(t_local[0])
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def hop(carry, _):
+            buf, recv, src = carry
+            recv = jax.lax.ppermute(recv, DATA_AXIS, perm)
+            src = jax.lax.ppermute(src, DATA_AXIS, perm)
+            buf = buf.at[src].set(recv)
+            return (buf, recv, src), None
+
+        (out, _, _), _ = jax.lax.scan(
+            hop, (out, t_local[0], idx), None, length=n - 1
+        )
+        return out
+
+    sharded = jax.jit(
+        jax.shard_map(
+            ring,
+            mesh=mesh,
+            in_specs=P(DATA_AXIS),
+            out_specs=P(None),
+            check_vma=False,
+        )
+    )
+    return np.asarray(sharded(times), dtype=np.float64)

@@ -114,6 +114,12 @@ class Config:
                                        # kernel; NOTE: drops attention-prob
                                        # dropout (a semantics change, hence a
                                        # separate knob from use_pallas)
+    shard_update: bool = False         # cross-replica weight-update sharding
+                                       # (ZeRO-1 analogue): fused path
+                                       # reduce-scatters grads, updates a 1/n
+                                       # momentum shard per chip, all-gathers
+                                       # the delta — optimizer memory / n_dev.
+                                       # Uniform-plan (dbs off) runs only.
     stream_chunk_steps: int = 128      # host data path streams the epoch in
                                        # windows of this many steps (gather +
                                        # device_put of window k+1 overlaps
@@ -141,6 +147,12 @@ class Config:
             raise ValueError("fault_mode must be 'virtual' or 'compute'")
         if self.straggler and len(self.straggler_factors()) != self.world_size:
             raise ValueError("straggler factor list length must equal world_size")
+        if self.shard_update and self.dynamic_batch_size:
+            raise ValueError(
+                "shard_update rides the fused uniform-plan path; it cannot be "
+                "combined with dynamic_batch_size (the elastic DBS path keeps "
+                "the replicated update)"
+            )
 
     def straggler_factors(self) -> List[float]:
         return [float(x) for x in self.straggler.split(",")] if self.straggler else []
@@ -211,6 +223,9 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--bucket", type=int, default=d.bucket)
     p.add_argument("--capacity_factor", type=float, default=d.capacity_factor)
     p.add_argument("--snap_to_bucket", type=str2bool, default=d.snap_to_bucket)
+    p.add_argument("--shard_update", type=str2bool, default=d.shard_update,
+                   help="ZeRO-1-style sharded optimizer update on the fused path "
+                        "(reduce_scatter grads / shard momentum / all_gather delta).")
     p.add_argument("--stream_chunk_steps", type=int, default=d.stream_chunk_steps,
                    help="Stream the host data path in windows of N steps "
                         "(prefetch overlaps compute); 0 = materialize whole epochs.")

@@ -224,6 +224,12 @@ class Trainer:
             seed=cfg.seed,
             sharding=replicated_sharding(self.mesh),
         )
+        if cfg.shard_update:
+            from dynamic_load_balance_distributeddnn_tpu.train.state import (
+                shard_optimizer_state,
+            )
+
+            self.state = shard_optimizer_state(self.state, self.mesh, cfg.momentum)
         augment = cfg.dataset in ("cifar10", "cifar100")
         self.steps = StepLibrary(
             self.spec,
@@ -235,6 +241,7 @@ class Trainer:
             grad_clip=cfg.grad_clip,
             compute_dtype=jnp.bfloat16 if cfg.precision == "bfloat16" else None,
             use_pallas=cfg.use_pallas,
+            shard_update=cfg.shard_update,
         )
 
     def _build_plan(self, epoch: int, batch_sizes: np.ndarray):
@@ -413,6 +420,13 @@ class Trainer:
         faults = self.injector.epoch_faults(epoch, plan.num_steps, ctx)
 
         t_epoch = time.perf_counter()
+        if cfg.shard_update and not self._can_use_fused(plan):
+            raise RuntimeError(
+                "shard_update requires the fused uniform path (one worker per "
+                "device, uniform plan, no compute-mode injection); this plan "
+                "fell back to the elastic path, whose replicated combine "
+                "cannot apply a sharded optimizer state"
+            )
         if self._can_use_fused(plan):
             train_metrics = self._train_epoch_fused(plan, faults, epoch)
         else:
@@ -704,9 +718,23 @@ class Trainer:
                 for r in range(self.ws_local)
             ]
 
+        # Per-worker constants for the whole epoch: one transfer, not one per
+        # step (each device_put is a host round trip — 5 puts/worker/step was
+        # most of the elastic path's dispatch overhead).
+        slow_dev = {}
+        for d in dev_order:
+            dev = topo.devices[d]
+            for r in groups[d]:
+                gr = self.rank_lo + r
+                slow_dev[r] = jax.device_put(
+                    jnp.int32(faults.slow_iters_per_step[gr]), dev
+                )
+
         # Streaming host path: window k+1 gathers on the prefetch thread while
-        # window k's steps dispatch (async). Window-local rows, absolute-step
-        # rng keys — identical math to the whole-epoch gather.
+        # window k's steps dispatch (async). Each window transfers ONCE per
+        # worker ([win, b_pad, ...] put); steps slice on-device. Window-local
+        # rows, absolute-step rng keys — identical math to the whole-epoch
+        # gather.
         ranges = self._chunk_ranges(plan.num_steps)
         first_data = None
         with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
@@ -717,36 +745,37 @@ class Trainer:
                     fut = pool.submit(gather_window, *ranges[i + 1])
                 if first_data is None:
                     first_data = data
+                staged_win = {}
+                for d in dev_order:
+                    dev = topo.devices[d]
+                    for r in groups[d]:
+                        x, y, w = data[r]
+                        gr = self.rank_lo + r
+                        kwin = wkeys[
+                            np.arange(w0, w1) * cfg.world_size + gr
+                        ]
+                        staged_win[r] = (
+                            jax.device_put(x, dev),
+                            jax.device_put(y, dev),
+                            jax.device_put(w, dev),
+                            jax.device_put(kwin, dev),
+                        )
                 for s_abs in range(w0, w1):
                     s = s_abs - w0
                     partials = {}
-                    staged = {}
-                    for d in dev_order:
-                        dev = topo.devices[d]
-                        for r in groups[d]:
-                            x, y, w = data[r]
-                            gr = self.rank_lo + r
-                            staged[r] = (
-                                jax.device_put(x[s], dev),
-                                jax.device_put(y[s], dev),
-                                jax.device_put(w[s], dev),
-                                jax.device_put(wkeys[s_abs * cfg.world_size + gr], dev),
-                                jax.device_put(
-                                    jnp.int32(faults.slow_iters_per_step[gr]), dev
-                                ),
-                            )
                     views = shard_views(self.state.params, self.topology.devices)
                     for d in dev_order:
                         acc = None
                         for r in groups[d]:
-                            xs, ys, ws_, key, slow = staged[r]
+                            xw, yw, ww, kw = staged_win[r]
+                            args = (xw[s], yw[s], ww[s], kw[s], slow_dev[r])
                             if acc is None:
                                 acc, aux = self.steps.worker_step_first(
-                                    views[d], xs, ys, ws_, key, slow
+                                    views[d], *args
                                 )
                             else:
                                 acc, aux = self.steps.worker_step_acc(
-                                    views[d], acc, xs, ys, ws_, key, slow
+                                    views[d], acc, *args
                                 )
                             aux_acc.append(aux)
                         partials[d] = acc

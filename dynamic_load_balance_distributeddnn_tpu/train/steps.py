@@ -76,6 +76,7 @@ class StepLibrary:
         grad_clip: float = 0.0,
         compute_dtype: Optional[Any] = None,
         use_pallas: bool = False,
+        shard_update: bool = False,
     ):
         self.spec = spec
         self.mesh = mesh
@@ -88,6 +89,11 @@ class StepLibrary:
         # bfloat16 mixed precision: params/activations cast for the forward/
         # backward, f32 master weights + f32 loss/grad accumulation
         self.compute_dtype = compute_dtype
+        # Cross-replica weight-update sharding (ZeRO-1 analogue, arXiv
+        # 2004.13336): fused path reduce-scatters gradients, updates a 1/n
+        # momentum shard, all-gathers the weight delta. Requires the state's
+        # opt_state to be a ShardedSGDState (train/state.py).
+        self.shard_update = shard_update
         self._build()
 
     def _cast_compute(self, tree):
@@ -199,6 +205,28 @@ class StepLibrary:
     # (evaluation is always the sharded fused_eval_step — there is no
     # single-device eval path)
 
+    def _state_spec(self):
+        """shard_map spec for the TrainState: fully replicated, except the
+        flat momentum trace when weight-update sharding is on (prefix-spec
+        pytree: ``params=P()`` covers the whole params subtree)."""
+        if not self.shard_update:
+            return P()
+        from dynamic_load_balance_distributeddnn_tpu.train.state import (
+            ShardedSGDState,
+            TrainState as TS,
+        )
+
+        return TS(
+            params=P(),
+            opt_state=ShardedSGDState(
+                hyperparams={"learning_rate": P()},
+                momentum=P(),
+                trace=P(DATA_AXIS),
+                count=P(),
+            ),
+            step=P(),
+        )
+
     def _fused_shard_body(self, state, x, y, w, slow_scalar, seed, with_comm=True):
         """Per-device body of the fused SPMD step: local grad, optional
         per-worker clip (reference clips before combining, dbs.py:274), psum,
@@ -237,6 +265,11 @@ class StepLibrary:
 
         probe = synthetic_load(slow_scalar, wloss)
         metrics = jnp.stack([wloss, loss_sum, count, probe])
+        if self.shard_update:
+            state = self._zero1_update(state, grads, with_comm)
+            if with_comm:
+                metrics = jax.lax.psum(metrics, DATA_AXIS)
+            return state, metrics
         if with_comm:
             grads = jax.lax.psum(grads, DATA_AXIS)
             metrics = jax.lax.psum(metrics, DATA_AXIS)
@@ -244,6 +277,47 @@ class StepLibrary:
         params = optax.apply_updates(state.params, updates)
         state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
         return state, metrics
+
+    def _zero1_update(self, state, local_grads, with_comm: bool):
+        """Sharded SGD(momentum) update: reduce_scatter local grads, update
+        this device's 1/n momentum shard, all_gather the weight delta
+        (identical math to ``optax.sgd``: t' = g + mu*t; p' = p - lr*t').
+        ``with_comm=False`` builds the comm-free probe twin: same FLOPs shape,
+        collectives replaced by local slices/pads (output is discarded)."""
+        import jax.flatten_util
+
+        opt = state.opt_state
+        n = len(self.mesh.devices.flat)
+        flat_g, unravel = jax.flatten_util.ravel_pytree(local_grads)
+        t_real = flat_g.size
+        padded = -(-t_real // n) * n
+        flat_g = jnp.pad(flat_g, (0, padded - t_real))
+        chunk = padded // n
+        if with_comm:
+            g_chunk = jax.lax.psum_scatter(
+                flat_g, DATA_AXIS, scatter_dimension=0, tiled=True
+            )
+        else:
+            idx = jax.lax.axis_index(DATA_AXIS)
+            g_chunk = jax.lax.dynamic_slice(flat_g, (idx * chunk,), (chunk,))
+        new_trace = g_chunk + opt.momentum * opt.trace
+        delta_chunk = opt.hyperparams["learning_rate"] * new_trace
+        if with_comm:
+            delta = jax.lax.all_gather(delta_chunk, DATA_AXIS, tiled=True)
+        else:
+            idx = jax.lax.axis_index(DATA_AXIS)
+            delta = jax.lax.dynamic_update_slice(
+                jnp.zeros((padded,), delta_chunk.dtype), delta_chunk, (idx * chunk,)
+            )
+        params = jax.tree_util.tree_map(
+            lambda p, d: p - d.reshape(p.shape).astype(p.dtype),
+            state.params,
+            unravel(delta[:t_real]),
+        )
+        opt_state = opt._replace(trace=new_trace, count=opt.count + 1)
+        return state.replace(
+            params=params, opt_state=opt_state, step=state.step + 1
+        )
 
     @functools.cached_property
     def fused_step(self):
@@ -257,8 +331,8 @@ class StepLibrary:
         sharded = jax.shard_map(
             per_shard,
             mesh=self.mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
-            out_specs=(P(), P()),
+            in_specs=(self._state_spec(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
         return jax.jit(sharded, donate_argnums=(0,))
@@ -283,14 +357,14 @@ class StepLibrary:
             per_shard,
             mesh=self.mesh,
             in_specs=(
-                P(),
+                self._state_spec(),
                 P(None, DATA_AXIS),
                 P(None, DATA_AXIS),
                 P(None, DATA_AXIS),
                 P(DATA_AXIS),
                 P(),
             ),
-            out_specs=(P(), P()),
+            out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
         return jax.jit(sharded, donate_argnums=(0,))
@@ -309,8 +383,8 @@ class StepLibrary:
         sharded = jax.shard_map(
             per_shard,
             mesh=self.mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
-            out_specs=(P(), P()),
+            in_specs=(self._state_spec(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
         return jax.jit(sharded)

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Wait for the TPU tunnel to recover, then run the queued TPU work:
+#   1. scripts/kernel_bench.py  -> artifacts/kernel_bench_tpu.json + KERNELS.md
+#   2. bench.py (full scale)    -> artifacts/BENCH_local_tpu.json
+# Logs to /tmp/tpu_queue.log. Safe to kill at any point.
+set -u
+cd "$(dirname "$0")/.."
+DEADLINE=$(( $(date +%s) + ${TPU_QUEUE_WAIT_S:-14400} ))
+
+echo "[queue] waiting for TPU (deadline in ${TPU_QUEUE_WAIT_S:-14400}s)"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if PROBE_CAP_S=300 python scripts/tpu_probe_once.py 2>&1 | grep -q "PROBE ok"; then
+    echo "[queue] TPU up at $(date -u +%H:%M:%S)"
+    echo "[queue] === kernel_bench ==="
+    timeout 2400 python scripts/kernel_bench.py --repeats 30 || echo "[queue] kernel_bench failed rc=$?"
+    echo "[queue] === full bench ==="
+    mkdir -p artifacts
+    BENCH_TOTAL_BUDGET=${BENCH_TOTAL_BUDGET:-5400} timeout 6000 python bench.py \
+      > artifacts/BENCH_local_tpu.json 2>/tmp/bench_full.log \
+      || echo "[queue] bench failed rc=$?"
+    echo "[queue] bench result: $(cat artifacts/BENCH_local_tpu.json 2>/dev/null | head -c 400)"
+    echo "[queue] === acceptance statis (heavy CNN configs) ==="
+    STATIS_ONLY=c2_resnet18,c3_densenet,c4_regnet_ws8 STATIS_WARM=true \
+      timeout 7200 python scripts/gen_statis.py --out_dir artifacts/acceptance \
+      >> /tmp/gen_statis_tpu.log 2>&1 \
+      || echo "[queue] gen_statis failed rc=$?"
+    echo "[queue] done"
+    exit 0
+  fi
+  echo "[queue] TPU still down at $(date -u +%H:%M:%S); sleeping 120s"
+  sleep 120
+done
+echo "[queue] gave up waiting for TPU"
+exit 1

@@ -1,0 +1,73 @@
+"""Cross-replica weight-update sharding (ZeRO-1 analogue, arXiv 2004.13336).
+
+The sharded update (reduce_scatter grads -> per-chip momentum shard ->
+all_gather delta) must train identically to the replicated optax update —
+same math, n_dev-fold less optimizer memory — and the trace must actually
+live sharded over the mesh.
+"""
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return synthetic_dataset("mnist", n_train=512, n_test=128)
+
+
+def _run(bundle, shard):
+    cfg = Config(
+        debug=True,
+        world_size=8,
+        batch_size=128,
+        learning_rate=0.05,
+        epoch_size=2,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=False,
+        one_cycle_policy=True,  # exercises with_learning_rate on both states
+        seed=11,
+        bucket=8,
+        shard_update=shard,
+    )
+    tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+    tr.run()
+    import jax
+
+    return tr, [np.asarray(l) for l in jax.tree_util.tree_leaves(tr.state.params)]
+
+
+def test_sharded_update_matches_replicated(bundle):
+    tr_rep, params_rep = _run(bundle, shard=False)
+    tr_sh, params_sh = _run(bundle, shard=True)
+    for a, b in zip(params_rep, params_sh):
+        # reduce_scatter+all_gather reassociates float sums vs psum — allow ulps
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(
+        tr_rep.recorder.data["train_loss"],
+        tr_sh.recorder.data["train_loss"],
+        rtol=1e-5,
+    )
+
+
+def test_trace_is_sharded_over_mesh(bundle):
+    tr, _ = _run(bundle, shard=True)
+    trace = tr.state.opt_state.trace
+    n_dev = len(tr.mesh.devices.flat)
+    assert trace.ndim == 1 and trace.shape[0] % n_dev == 0
+    shards = trace.addressable_shards
+    assert len(shards) == n_dev
+    for s in shards:
+        assert s.data.shape[0] == trace.shape[0] // n_dev
+    # momentum is real after training (nonzero trace)
+    assert float(np.abs(np.asarray(trace)).max()) > 0
+
+
+def test_shard_update_rejects_dbs():
+    with pytest.raises(ValueError):
+        Config(debug=True, dynamic_batch_size=True, shard_update=True,
+               model="mnistnet", dataset="mnist")

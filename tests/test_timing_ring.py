@@ -1,0 +1,32 @@
+"""Device-side ppermute time ring (the reference's isend/recv ring topology,
+dbs.py:479-499, rebuilt on ICI collectives)."""
+
+import numpy as np
+
+from dynamic_load_balance_distributeddnn_tpu.balance.timing import (
+    exchange_times,
+    ring_exchange_times,
+)
+
+
+def test_ring_exchange_matches_input_order(devices):
+    n = len(devices)
+    times = np.linspace(0.5, 4.0, n)
+    out = ring_exchange_times(times)
+    np.testing.assert_allclose(out, times, rtol=1e-6)
+
+
+def test_ring_exchange_permutation_independence(devices):
+    """Every device slot carries exactly its own worker's scalar — a shuffled
+    input must come back identically shuffled (no slot mixing, mirroring the
+    reference's rotate+reverse ordering fix, dbs.py:495-498)."""
+    n = len(devices)
+    rng = np.random.RandomState(3)
+    times = rng.uniform(0.1, 9.0, size=n)
+    out = ring_exchange_times(times)
+    np.testing.assert_allclose(out, times, rtol=1e-6)
+
+
+def test_host_exchange_single_process_identity():
+    t = np.array([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(exchange_times(t), t)

@@ -215,6 +215,38 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
             if tr.recorder.data.get(k):
                 out["instr"][f"{arm}_{k}"] = tr.recorder.data[k][-1]
         _write_atomic(out_path, out)
+
+    if os.environ.get("BENCH_CLEAN", "1") == "1" and len(resume.get("clean", [])) < 2:
+        # Clean-throughput leg: no straggler, fused whole-epoch SPMD scan —
+        # the framework's peak single-pod-slice throughput/MFU (the A/B arms
+        # run the elastic path under injection, which can't show this).
+        cfg = Config(
+            debug=False,
+            world_size=ws,
+            batch_size=batch,
+            learning_rate=0.01,
+            epoch_size=2,
+            dataset=dataset,
+            model=model,
+            dynamic_batch_size=False,
+            fault_tolerance=False,
+            bucket=bucket,
+            precision=precision,
+        )
+        tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+        for e in range(2):
+            out.setdefault("clean", []).append(round(tr.run_epoch(e)["epoch_wall"], 4))
+            _write_atomic(out_path, out)
+        for k in ("examples_per_s", "mfu_bf16_peak"):
+            if tr.recorder.data.get(k):
+                out["instr"][f"clean_{k}"] = tr.recorder.data[k][-1]
+        _write_atomic(out_path, out)
+    elif resume.get("clean"):
+        out["clean"] = resume["clean"]
+        for k, v in resume.get("instr", {}).items():
+            if k.startswith("clean_"):
+                out["instr"][k] = v
+        _write_atomic(out_path, out)
     return 0
 
 
@@ -240,6 +272,7 @@ def _result_from(partial) -> dict | None:
         "model": partial.get("model"),
         "dbs_off_epochs_s": partial.get("off"),
         "dbs_on_epochs_s": partial.get("on"),
+        "clean_fused_epochs_s": partial.get("clean"),
         "n_train": partial.get("n_train"),
         "world_size": partial.get("world_size"),
         **partial.get("instr", {}),

@@ -66,6 +66,17 @@ def restore_checkpoint(
     )
     state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
     mgr.close()
+    # Re-place every leaf onto the live template's sharding: orbax restores
+    # values, but default placement (single-device scalars) would poison the
+    # next jit with mixed device sets — params must come back replicated over
+    # the mesh and the ZeRO-1 trace sharded along it.
+    state = jax.tree_util.tree_map(
+        lambda restored, tmpl: jax.device_put(
+            restored, getattr(tmpl, "sharding", None)
+        ),
+        state,
+        state_template,
+    )
     controller: Dict[str, Any] = {}
     side = os.path.join(ckpt_dir, f"controller_{step}.json")
     if os.path.exists(side):

@@ -78,15 +78,22 @@ def shard_optimizer_state(state: TrainState, mesh, momentum: float = 0.9) -> Tra
     trace = jax.device_put(
         jnp.zeros((padded,), jnp.float32), NamedSharding(mesh, P(DATA_AXIS))
     )
+    # Scalars committed REPLICATED over the mesh (not default-device): this
+    # state doubles as the restore template, and a single-device-committed
+    # leaf would clash with the mesh-wide jit after checkpoint resume.
+    rep = NamedSharding(mesh, P())
     opt_state = ShardedSGDState(
         hyperparams={
-            "learning_rate": jnp.asarray(
-                state.opt_state.hyperparams["learning_rate"], jnp.float32
+            "learning_rate": jax.device_put(
+                jnp.asarray(
+                    state.opt_state.hyperparams["learning_rate"], jnp.float32
+                ),
+                rep,
             )
         },
-        momentum=jnp.asarray(momentum, jnp.float32),
+        momentum=jax.device_put(jnp.asarray(momentum, jnp.float32), rep),
         trace=trace,
-        count=jnp.zeros((), jnp.int32),
+        count=jax.device_put(jnp.zeros((), jnp.int32), rep),
     )
     return state.replace(opt_state=opt_state)
 

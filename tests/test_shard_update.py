@@ -71,3 +71,43 @@ def test_shard_update_rejects_dbs():
     with pytest.raises(ValueError):
         Config(debug=True, dynamic_batch_size=True, shard_update=True,
                model="mnistnet", dataset="mnist")
+
+
+def test_sharded_state_checkpoint_roundtrip(bundle, tmp_path):
+    """Orbax must save/restore the sharded trace with its sharding intact and
+    training must continue from it (the DBS upgrade path, SURVEY §5.4)."""
+    cfg = Config(
+        debug=True,
+        world_size=8,
+        batch_size=128,
+        learning_rate=0.05,
+        epoch_size=1,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=False,
+        seed=12,
+        bucket=8,
+        shard_update=True,
+        ckpt_dir=str(tmp_path / "ckpt"),
+    )
+    tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+    tr.run()
+    trace_after = np.asarray(tr.state.opt_state.trace)
+
+    from dynamic_load_balance_distributeddnn_tpu.train.checkpoint import (
+        restore_checkpoint,
+    )
+
+    tr2 = Trainer(
+        cfg.replace(epoch_size=2), bundle=bundle, log_to_file=False
+    )
+    # the saved sharded trace restores exactly (restore happens inside run();
+    # probe it directly first)
+    step, restored, _ = restore_checkpoint(cfg.ckpt_dir, tr2.state)
+    assert step == 0
+    np.testing.assert_allclose(
+        np.asarray(restored.opt_state.trace), trace_after, rtol=1e-6
+    )
+    tr2.run()  # resumes: runs only epoch 1
+    assert list(tr2.recorder.data["epoch"]) == [1]
+    assert len(tr2.state.opt_state.trace.addressable_shards) == 8

@@ -104,6 +104,41 @@ class FlashSelfAttention(nn.Module):
         )(o)
 
 
+class UlyssesSelfAttention(nn.Module):
+    """Causal multi-head self-attention over a SEQUENCE-SHARDED axis via
+    head all-to-all (parallel/ulysses.py): each device ends up with the FULL
+    sequence for a head subset. Must be applied inside a ``shard_map`` whose
+    mesh carries ``axis_name``. Same param layout as ``RingSelfAttention`` /
+    ``nn.MultiHeadDotProductAttention`` — weights are interchangeable."""
+
+    num_heads: int
+    qkv_features: int
+    axis_name: str
+
+    @nn.compact
+    def __call__(self, x):
+        from dynamic_load_balance_distributeddnn_tpu.parallel.ulysses import (
+            ulysses_self_attention,
+        )
+
+        h = self.num_heads
+        hd = self.qkv_features // h
+        dense = functools.partial(nn.DenseGeneral, features=(h, hd), axis=-1)
+        q = dense(name="query")(x)  # [B, T_local, H, hd]
+        k = dense(name="key")(x)
+        v = dense(name="value")(x)
+        o = ulysses_self_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            axis_name=self.axis_name,
+            causal=True,
+        ).transpose(0, 2, 1, 3)
+        return nn.DenseGeneral(
+            features=self.qkv_features, axis=(-2, -1), name="out"
+        )(o)
+
+
 class EncoderLayer(nn.Module):
     """Post-LN transformer encoder layer (torch convention)."""
 
@@ -112,14 +147,19 @@ class EncoderLayer(nn.Module):
     d_ff: int
     dropout: float
     use_flash: bool = False
-    seq_axis: str = ""  # non-empty: ring attention over this sharded axis
+    seq_axis: str = ""  # non-empty: sequence parallelism over this sharded axis
+    sp_mode: str = "ring"  # "ring" (ppermute pipeline) | "ulysses" (head a2a)
 
     @nn.compact
     def __call__(self, x, mask, train: bool):
-        # all three variants share the scope name "attn" and the same
+        # all variants share the scope name "attn" and the same
         # query/key/value/out param layout, so weights are interchangeable
         # across single-device, flash and sequence-parallel modes
-        if self.seq_axis:
+        if self.seq_axis and self.sp_mode == "ulysses":
+            attn = UlyssesSelfAttention(
+                self.nhead, self.d_model, self.seq_axis, name="attn"
+            )(x)
+        elif self.seq_axis:
             attn = RingSelfAttention(
                 self.nhead, self.d_model, self.seq_axis, name="attn"
             )(x)
@@ -155,8 +195,10 @@ class TransformerLM(nn.Module):
     use_flash: bool = False  # route attention through the Pallas flash kernel
     seq_axis: str = ""  # non-empty: sequence-parallel mode — tokens arrive as
                         # the local shard of a T-sharded global sequence (call
-                        # inside shard_map); attention rings over this axis and
-                        # positions are offset by the shard index
+                        # inside shard_map); attention parallelizes over this
+                        # axis and positions are offset by the shard index
+    sp_mode: str = "ring"  # "ring" (ppermute KV pipeline, parallel/ring.py) |
+                           # "ulysses" (head all-to-all, parallel/ulysses.py)
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -202,6 +244,7 @@ class TransformerLM(nn.Module):
                 self.dropout,
                 self.use_flash,
                 self.seq_axis,
+                self.sp_mode,
             )(x, causal, train)
         # Raw logits; the loss layer applies softmax cross-entropy, which on
         # logits equals the reference's NLLLoss-on-log_softmax composition

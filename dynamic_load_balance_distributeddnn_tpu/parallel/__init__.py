@@ -4,10 +4,22 @@ from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
     replicated_sharding,
     stacked_sharding,
 )
+from dynamic_load_balance_distributeddnn_tpu.parallel.ring import (
+    make_ring_attention_fn,
+    ring_self_attention,
+)
+from dynamic_load_balance_distributeddnn_tpu.parallel.ulysses import (
+    make_ulysses_attention_fn,
+    ulysses_self_attention,
+)
 
 __all__ = [
     "WorkerTopology",
     "data_mesh",
+    "make_ring_attention_fn",
+    "make_ulysses_attention_fn",
     "replicated_sharding",
+    "ring_self_attention",
     "stacked_sharding",
+    "ulysses_self_attention",
 ]

@@ -114,6 +114,13 @@ class Config:
                                        # kernel; NOTE: drops attention-prob
                                        # dropout (a semantics change, hence a
                                        # separate knob from use_pallas)
+    grad_accum: int = 1                # fused-path micro-batching: each step's
+                                       # per-device batch is processed in this
+                                       # many scanned slices, grads summed
+                                       # before the collective (exact under
+                                       # per-example weighting); activation
+                                       # memory / grad_accum. Absent in the
+                                       # reference (SURVEY §2.5).
     shard_update: bool = False         # cross-replica weight-update sharding
                                        # (ZeRO-1 analogue): fused path
                                        # reduce-scatters grads, updates a 1/n
@@ -147,6 +154,11 @@ class Config:
             raise ValueError("fault_mode must be 'virtual' or 'compute'")
         if self.straggler and len(self.straggler_factors()) != self.world_size:
             raise ValueError("straggler factor list length must equal world_size")
+        if self.grad_accum > 1 and self.dynamic_batch_size:
+            raise ValueError(
+                "grad_accum rides the fused uniform-plan path; the elastic DBS "
+                "path controls memory by shrinking per-worker batches instead"
+            )
         if self.shard_update and self.dynamic_batch_size:
             raise ValueError(
                 "shard_update rides the fused uniform-plan path; it cannot be "
@@ -223,6 +235,9 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--bucket", type=int, default=d.bucket)
     p.add_argument("--capacity_factor", type=float, default=d.capacity_factor)
     p.add_argument("--snap_to_bucket", type=str2bool, default=d.snap_to_bucket)
+    p.add_argument("--grad_accum", type=int, default=d.grad_accum,
+                   help="Fused-path micro-batching factor (activation memory "
+                        "/ N, grads summed before the collective; exact).")
     p.add_argument("--shard_update", type=str2bool, default=d.shard_update,
                    help="ZeRO-1-style sharded optimizer update on the fused path "
                         "(reduce_scatter grads / shard momentum / all_gather delta).")

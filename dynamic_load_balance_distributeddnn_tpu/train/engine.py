@@ -242,6 +242,7 @@ class Trainer:
             compute_dtype=jnp.bfloat16 if cfg.precision == "bfloat16" else None,
             use_pallas=cfg.use_pallas,
             shard_update=cfg.shard_update,
+            grad_accum=cfg.grad_accum,
         )
 
     def _build_plan(self, epoch: int, batch_sizes: np.ndarray):
@@ -420,12 +421,11 @@ class Trainer:
         faults = self.injector.epoch_faults(epoch, plan.num_steps, ctx)
 
         t_epoch = time.perf_counter()
-        if cfg.shard_update and not self._can_use_fused(plan):
+        if (cfg.shard_update or cfg.grad_accum > 1) and not self._can_use_fused(plan):
             raise RuntimeError(
-                "shard_update requires the fused uniform path (one worker per "
-                "device, uniform plan, no compute-mode injection); this plan "
-                "fell back to the elastic path, whose replicated combine "
-                "cannot apply a sharded optimizer state"
+                "shard_update/grad_accum require the fused uniform path (one "
+                "worker per device, uniform plan, no compute-mode injection); "
+                "this plan fell back to the elastic path"
             )
         if self._can_use_fused(plan):
             train_metrics = self._train_epoch_fused(plan, faults, epoch)

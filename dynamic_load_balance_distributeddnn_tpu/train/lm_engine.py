@@ -103,6 +103,7 @@ class LMTrainer(Trainer):
             grad_clip=grad_clip,
             compute_dtype=jnp.bfloat16 if cfg.precision == "bfloat16" else None,
             use_pallas=cfg.use_pallas,
+            grad_accum=cfg.grad_accum,
         )
 
     def _dummy_batch(self, b: int):

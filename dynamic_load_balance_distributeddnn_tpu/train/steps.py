@@ -77,6 +77,7 @@ class StepLibrary:
         compute_dtype: Optional[Any] = None,
         use_pallas: bool = False,
         shard_update: bool = False,
+        grad_accum: int = 1,
     ):
         self.spec = spec
         self.mesh = mesh
@@ -94,6 +95,10 @@ class StepLibrary:
         # momentum shard, all-gathers the weight delta. Requires the state's
         # opt_state to be a ShardedSGDState (train/state.py).
         self.shard_update = shard_update
+        # Micro-batching inside the fused step (lax.scan over batch slices,
+        # grads summed before the collective) — exact under per-example
+        # weighting; activation memory scales with batch/grad_accum.
+        self.grad_accum = max(int(grad_accum), 1)
         self._build()
 
     def _cast_compute(self, tree):
@@ -245,17 +250,54 @@ class StepLibrary:
             jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(0), seed), idx),
             state.step,
         )
-        x = self._cast_compute(self._prep_images(x, rng, train=True))
 
-        def loss_fn(p):
-            out = apply_fn(self._cast_compute(p), x, train=True, rngs={"dropout": rng})
-            losses = _per_example_loss(spec, out.astype(jnp.float32), y, self.use_pallas)
-            mask = (w > 0).astype(jnp.float32)
-            return jnp.sum(losses * w), (jnp.sum(losses * mask), jnp.sum(mask))
+        def slice_grads(x_s, y_s, w_s, rng_s):
+            """Weighted loss + grads for one (micro-)batch slice. Per-example
+            weighting makes accumulation exact: sums of weighted slice grads
+            equal the whole-batch weighted grad."""
+            x_p = self._cast_compute(self._prep_images(x_s, rng_s, train=True))
 
-        (wloss, (loss_sum, count)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+            def loss_fn(p):
+                out = apply_fn(
+                    self._cast_compute(p), x_p, train=True, rngs={"dropout": rng_s}
+                )
+                losses = _per_example_loss(
+                    spec, out.astype(jnp.float32), y_s, self.use_pallas
+                )
+                mask = (w_s > 0).astype(jnp.float32)
+                return jnp.sum(losses * w_s), (jnp.sum(losses * mask), jnp.sum(mask))
+
+            return jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+
+        acc = self.grad_accum
+        if acc > 1:
+            b = x.shape[0]
+            assert b % acc == 0, (
+                f"per-device batch {b} must divide by grad_accum {acc}"
+            )
+
+            def micro(carry, inp):
+                g_acc, wl, ls, cnt, i = carry
+                x_s, y_s, w_s = inp
+                (wl_s, (ls_s, cnt_s)), g = slice_grads(
+                    x_s, y_s, w_s, jax.random.fold_in(rng, i)
+                )
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                return (g_acc, wl + wl_s, ls + ls_s, cnt + cnt_s, i + 1), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            stacked = (
+                x.reshape((acc, b // acc) + x.shape[1:]),
+                y.reshape((acc, b // acc) + y.shape[1:]),
+                w.reshape((acc, b // acc) + w.shape[1:]),
+            )
+            (grads, wloss, loss_sum, count, _), _ = jax.lax.scan(
+                micro,
+                (zeros, jnp.float32(0), jnp.float32(0), jnp.float32(0), jnp.int32(0)),
+                stacked,
+            )
+        else:
+            (wloss, (loss_sum, count)), grads = slice_grads(x, y, w, rng)
         if self.grad_clip > 0:
             w_r = jnp.maximum(jnp.sum(w), 1e-12)
             unscaled = jax.tree_util.tree_map(lambda g: g / w_r, grads)

@@ -46,6 +46,9 @@ def main() -> None:
         model="mnistnet",
         dynamic_batch_size=True,
         bucket=8,
+        # small window so the streaming host path (prefetch + per-window
+        # make_array_from_process_local_data) is exercised ACROSS processes
+        stream_chunk_steps=2,
     )
 
     factors = np.array([3.0, 1.0, 1.0, 1.0])

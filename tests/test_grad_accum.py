@@ -1,0 +1,83 @@
+"""Fused-path gradient accumulation: micro-batched scan must be EXACT.
+
+Per-example weighting makes the weighted loss a sum over examples, so
+summing slice gradients equals the whole-batch gradient — no averaging
+subtleties. With dropout off the equality is bitwise-level tight.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.models import build_model
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+    batch_sharding,
+    data_mesh,
+    replicated_sharding,
+)
+from dynamic_load_balance_distributeddnn_tpu.train.state import create_state, make_optimizer
+from dynamic_load_balance_distributeddnn_tpu.train.steps import StepLibrary
+
+
+def _fused_once(grad_accum):
+    mesh = data_mesh()
+    n = len(mesh.devices.flat)
+    spec = build_model(
+        "transformer", ntoken=50, ninp=16, nhead=2, nhid=16, nlayers=1, dropout=0.0
+    )
+    tx = make_optimizer(0.05, 0.9)
+    rng = np.random.RandomState(0)
+    b = n * 8  # 8 per device; accum 4 -> slices of 2
+    toks = jnp.asarray(rng.randint(0, 50, (b, 12)), jnp.int32)
+    tgts = jnp.asarray(rng.randint(0, 50, (b, 12)), jnp.int32)
+    w = jnp.asarray(np.full((b, 12), 1.0 / (b * 12), np.float32))
+
+    state = create_state(
+        spec.module, toks[:1], tx, seed=3, sharding=replicated_sharding(mesh)
+    )
+    lib = StepLibrary(spec, mesh, tx, grad_accum=grad_accum)
+    x = jax.device_put(toks, batch_sharding(mesh, 2))
+    y = jax.device_put(tgts, batch_sharding(mesh, 2))
+    ws = jax.device_put(w, batch_sharding(mesh, 2))
+    slow = jax.device_put(np.zeros((n,), np.int32), batch_sharding(mesh, 1))
+    state, metrics = lib.fused_step(state, x, y, ws, slow, jnp.int32(0))
+    return (
+        [np.asarray(l) for l in jax.tree_util.tree_leaves(state.params)],
+        np.asarray(metrics),
+    )
+
+
+def test_grad_accum_exact_vs_whole_batch():
+    params_1, metrics_1 = _fused_once(1)
+    params_4, metrics_4 = _fused_once(4)
+    np.testing.assert_allclose(metrics_1[:3], metrics_4[:3], rtol=1e-6)
+    for a, b in zip(params_1, params_4):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_grad_accum_rejects_dbs():
+    with pytest.raises(ValueError):
+        Config(debug=True, dynamic_batch_size=True, grad_accum=2,
+               model="mnistnet", dataset="mnist")
+
+
+def test_grad_accum_end_to_end_trains():
+    """Engine-level: dbs-off run with grad_accum=2 learns and records."""
+    from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+    from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+    cfg = Config(
+        debug=True, world_size=8, batch_size=128, learning_rate=0.05,
+        epoch_size=2, dataset="mnist", model="mnistnet",
+        dynamic_batch_size=False, seed=5, bucket=8, grad_accum=2,
+    )
+    tr = Trainer(
+        cfg,
+        bundle=synthetic_dataset("mnist", n_train=512, n_test=128),
+        log_to_file=False,
+    )
+    rec = tr.run()
+    losses = rec.data["train_loss"]
+    assert len(losses) == 2 and np.isfinite(losses).all()

@@ -28,7 +28,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print("===========================\n")
         return 0
 
-    if cfg.model == "transformer":
+    if cfg.model == "transformer" and cfg.seq_parallel:
+        from dynamic_load_balance_distributeddnn_tpu.train.sp_engine import (
+            SeqParallelLMTrainer,
+        )
+
+        trainer = SeqParallelLMTrainer(cfg)
+    elif cfg.model == "transformer":
         from dynamic_load_balance_distributeddnn_tpu.train.lm_engine import LMTrainer
 
         trainer = LMTrainer(cfg)

@@ -105,6 +105,10 @@ class Config:
     stat_dir: str = "./statis"
     ckpt_dir: str = ""                 # non-empty → orbax checkpointing on
     bptt: int = 35                     # LM window (dbs.py:343)
+    seq_parallel: str = ""             # "ring" | "ulysses": train the LM with
+                                       # the SEQUENCE axis sharded over the
+                                       # mesh (long-context mode; bptt scales
+                                       # with the mesh). "" = DBS data-parallel
     grad_clip: float = 0.0             # LM path uses 0.25 (dbs.py:274)
     profile_dir: str = ""              # non-empty → jax.profiler traces
     use_pallas: bool = False           # route GroupNorm/xent through the
@@ -197,6 +201,8 @@ class Config:
         )
         if self.disable_enhancements:
             name = "puredbs=" + name
+        if self.seq_parallel:
+            name = f"sp_{self.seq_parallel}=" + name  # distinct artifact lineage
         return name
 
     def replace(self, **kw) -> "Config":
@@ -257,6 +263,11 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--stat_dir", type=str, default=d.stat_dir)
     p.add_argument("--ckpt_dir", type=str, default=d.ckpt_dir)
     p.add_argument("--bptt", type=int, default=d.bptt)
+    p.add_argument("--seq_parallel", type=str, default=d.seq_parallel,
+                   choices=["", "ring", "ulysses"],
+                   help="Long-context LM mode: shard the sequence axis over "
+                        "the mesh (ring ppermute pipeline or Ulysses head "
+                        "all-to-all attention).")
     p.add_argument("--grad_clip", type=float, default=d.grad_clip)
     p.add_argument("--profile_dir", type=str, default=d.profile_dir)
     p.add_argument("--use_pallas", type=str2bool, default=d.use_pallas)

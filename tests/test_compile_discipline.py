@@ -1,0 +1,67 @@
+"""XLA-compile discipline: the bucketed shape ladder BOUNDS recompilation.
+
+The DBS balancer changes per-worker batch sizes every epoch; on TPU each new
+shape is an XLA compile. The design contract (SURVEY §7.3, config.bucket/
+snap_to_bucket) is that batch shapes live on a fixed ladder of bucket
+multiples, so the jit cache can never exceed (used devices) x (ladder rungs)
+entries for the worker step, and the combine/update executable compiles
+exactly once. A regression in snapping/planning (fractional padded batches,
+time-noise-driven churn) blows straight past these bounds.
+"""
+
+import numpy as np
+
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
+from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+
+def test_dbs_recompiles_bounded_by_ladder(tmp_path):
+    ws, batch, bucket = 4, 128, 8
+    cfg = Config(
+        debug=True,
+        world_size=ws,
+        batch_size=batch,
+        learning_rate=0.05,
+        epoch_size=4,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=True,
+        fault_tolerance=True,
+        seed=21,
+        bucket=bucket,
+        stat_dir=str(tmp_path),
+    )
+    tr = Trainer(
+        cfg,
+        bundle=synthetic_dataset("mnist", n_train=1024, n_test=128),
+        injector=StaticStragglerInjector([3.0, 1.0, 1.0, 1.0], mode="virtual"),
+        timing_model=lambda plan: np.array(
+            [3.0, 1.0, 1.0, 1.0]
+        ) * np.array([w.batch_size * w.steps for w in plan.workers]),
+        log_to_file=False,
+    )
+    tr.run()
+
+    max_share = min(1.0, cfg.capacity_factor / ws)
+    max_b = -(-int(np.ceil(max_share * batch)) // bucket) * bucket
+    ladder_len = len(range(bucket, max_b + 1, bucket))
+    n_used = len(tr.topology.used_device_indices)
+
+    # worker executables: at most one per (device, ladder rung)
+    bound = n_used * ladder_len
+    assert tr.steps.worker_step_first._cache_size() <= bound, (
+        tr.steps.worker_step_first._cache_size(), bound
+    )
+    # the shapes that actually ran must all be bucket multiples
+    shares = np.array(tr.recorder.data["partition"])
+    batches = np.rint(shares * batch).astype(int)
+    # (quantize_batches snaps to the bucket ladder)
+    for b in np.unique(batches):
+        if b > 0:
+            assert b % bucket == 0 or b == batches.min(), (b, bucket)
+    # combine/update: constant stacked-gradient shapes -> O(1) compiles
+    # (2 observed: input layout variance on the first stacked tree; the
+    # contract is that it does NOT scale with epochs or plans)
+    assert tr.steps.combine_update._cache_size() <= 2

@@ -70,3 +70,32 @@ def test_sweep_runs_grid_and_is_idempotent(tmp_path, monkeypatch):
     assert len([f for f in stats if f.endswith(".npy")]) == 2  # dbs on + off
     assert sweep.main(argv) == 0  # all legs skipped, still rc 0
     assert sorted(os.listdir(tmp_path / "statis")) == stats
+
+
+def test_profiler_trace_artifacts(tmp_path):
+    """--profile_dir wraps the run in jax.profiler start/stop_trace and
+    leaves a TensorBoard-loadable trace on disk (SURVEY §5.1 upgrade: the
+    reference has wall-clock timing only)."""
+    import os
+
+    from dynamic_load_balance_distributeddnn_tpu.config import Config
+    from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+    from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+    prof = tmp_path / "prof"
+    cfg = Config(
+        debug=True, world_size=2, batch_size=64, learning_rate=0.05,
+        epoch_size=1, dataset="mnist", model="mnistnet",
+        dynamic_batch_size=False, bucket=8,
+        profile_dir=str(prof), stat_dir=str(tmp_path),
+    )
+    tr = Trainer(
+        cfg, bundle=synthetic_dataset("mnist", n_train=256, n_test=64),
+        log_to_file=False,
+    )
+    tr.run()
+    found = []
+    for root, _dirs, files in os.walk(prof):
+        found += [f for f in files if f.endswith((".pb", ".json.gz", ".trace"))
+                  or "trace" in f]
+    assert found, f"no trace artifacts under {prof}"

@@ -207,18 +207,33 @@ def main():
     print(f"[kernel_bench] {platform}/{kind}", flush=True)
     dtype = jnp.bfloat16 if ns.dtype == "bfloat16" else jnp.float32
 
-    results = []
+    os.makedirs(ns.out_dir, exist_ok=True)
+    json_path = os.path.join(ns.out_dir, f"kernel_bench_{platform}.json")
+
+    class _IncrementalResults(list):
+        """Persist after every row — a runtime outage mid-bench (the TPU
+        tunnel can drop) must not lose completed measurements."""
+
+        def append(self, row):
+            super().append(row)
+            payload = {
+                "platform": platform,
+                "device_kind": kind,
+                "dtype": ns.dtype,
+                "results": list(self),
+            }
+            tmp = json_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=2)
+            os.replace(tmp, json_path)
+            with open(os.path.join(ns.out_dir, "KERNELS.md"), "w") as f:
+                f.write(to_markdown(self, platform, kind))
+
+    results = _IncrementalResults()
     bench_attention(results, dtype, ns.repeats, ns.quick)
     bench_groupnorm(results, dtype, ns.repeats, ns.quick)
     bench_xent(results, dtype, ns.repeats, ns.quick)
-
-    os.makedirs(ns.out_dir, exist_ok=True)
-    payload = {"platform": platform, "device_kind": kind, "dtype": ns.dtype, "results": results}
-    with open(os.path.join(ns.out_dir, f"kernel_bench_{platform}.json"), "w") as f:
-        json.dump(payload, f, indent=2)
-    with open(os.path.join(ns.out_dir, "KERNELS.md"), "w") as f:
-        f.write(to_markdown(results, platform, kind))
-    print(f"[kernel_bench] wrote {ns.out_dir}/kernel_bench_{platform}.json")
+    print(f"[kernel_bench] wrote {json_path}")
     return 0
 
 

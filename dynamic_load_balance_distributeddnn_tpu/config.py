@@ -118,6 +118,12 @@ class Config:
                                        # kernel; NOTE: drops attention-prob
                                        # dropout (a semantics change, hence a
                                        # separate knob from use_pallas)
+    compress_grads: str = ""           # "int8": gradient collective quantized
+                                       # to 127 levels (shared pmax scale,
+                                       # stochastic rounding — unbiased, no
+                                       # error feedback needed), summed in
+                                       # int16: half the wire bytes. Opt-in,
+                                       # fused path only.
     grad_accum: int = 1                # fused-path micro-batching: each step's
                                        # per-device batch is processed in this
                                        # many scanned slices, grads summed
@@ -158,6 +164,15 @@ class Config:
             raise ValueError("fault_mode must be 'virtual' or 'compute'")
         if self.straggler and len(self.straggler_factors()) != self.world_size:
             raise ValueError("straggler factor list length must equal world_size")
+        if self.compress_grads not in ("", "int8"):
+            raise ValueError("compress_grads must be '' or 'int8'")
+        if self.compress_grads and self.dynamic_batch_size:
+            raise ValueError(
+                "compress_grads rides the fused uniform-plan path (the "
+                "elastic DBS combine keeps exact f32 gradients)"
+            )
+        if self.compress_grads and self.shard_update:
+            raise ValueError("compress_grads and shard_update are exclusive")
         if self.grad_accum > 1 and self.dynamic_batch_size:
             raise ValueError(
                 "grad_accum rides the fused uniform-plan path; the elastic DBS "
@@ -241,6 +256,10 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--bucket", type=int, default=d.bucket)
     p.add_argument("--capacity_factor", type=float, default=d.capacity_factor)
     p.add_argument("--snap_to_bucket", type=str2bool, default=d.snap_to_bucket)
+    p.add_argument("--compress_grads", type=str, default=d.compress_grads,
+                   choices=["", "int8"],
+                   help="Quantized gradient collective (stochastic rounding, "
+                        "int16 wire sum): half the collective bytes.")
     p.add_argument("--grad_accum", type=int, default=d.grad_accum,
                    help="Fused-path micro-batching factor (activation memory "
                         "/ N, grads summed before the collective; exact).")

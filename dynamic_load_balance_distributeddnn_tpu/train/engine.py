@@ -243,6 +243,7 @@ class Trainer:
             use_pallas=cfg.use_pallas,
             shard_update=cfg.shard_update,
             grad_accum=cfg.grad_accum,
+            compress_grads=cfg.compress_grads,
         )
 
     def _build_plan(self, epoch: int, batch_sizes: np.ndarray):
@@ -421,7 +422,9 @@ class Trainer:
         faults = self.injector.epoch_faults(epoch, plan.num_steps, ctx)
 
         t_epoch = time.perf_counter()
-        if (cfg.shard_update or cfg.grad_accum > 1) and not self._can_use_fused(plan):
+        if (
+            cfg.shard_update or cfg.grad_accum > 1 or cfg.compress_grads
+        ) and not self._can_use_fused(plan):
             raise RuntimeError(
                 "shard_update/grad_accum require the fused uniform path (one "
                 "worker per device, uniform plan, no compute-mode injection); "

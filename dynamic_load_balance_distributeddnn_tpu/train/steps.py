@@ -78,6 +78,7 @@ class StepLibrary:
         use_pallas: bool = False,
         shard_update: bool = False,
         grad_accum: int = 1,
+        compress_grads: str = "",
     ):
         self.spec = spec
         self.mesh = mesh
@@ -99,6 +100,11 @@ class StepLibrary:
         # grads summed before the collective) — exact under per-example
         # weighting; activation memory scales with batch/grad_accum.
         self.grad_accum = max(int(grad_accum), 1)
+        # "int8": gradient collective quantized to 8-bit levels with a shared
+        # pmax scale and STOCHASTIC rounding (unbiased — no error-feedback
+        # state needed), summed in int16 on the wire. Halves collective bytes
+        # vs f32 at 127-level precision; opt-in, fused path only.
+        self.compress_grads = compress_grads
         self._build()
 
     def _cast_compute(self, tree):
@@ -313,12 +319,36 @@ class StepLibrary:
                 metrics = jax.lax.psum(metrics, DATA_AXIS)
             return state, metrics
         if with_comm:
-            grads = jax.lax.psum(grads, DATA_AXIS)
+            if self.compress_grads == "int8":
+                grads = self._compressed_psum(grads, rng)
+            else:
+                grads = jax.lax.psum(grads, DATA_AXIS)
             metrics = jax.lax.psum(metrics, DATA_AXIS)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
         return state, metrics
+
+    def _compressed_psum(self, grads, rng):
+        """Quantized gradient collective (compressed-allreduce family): per
+        leaf, all devices agree on a shared scale via pmax, quantize to
+        127 levels with stochastic rounding (E[dequant] == grad, so no
+        error-feedback buffer is required), and psum in int16 — half the
+        wire bytes of an f32 collective. The scale pmax is a scalar per leaf,
+        negligible next to the tensor traffic."""
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        out = []
+        for i, g in enumerate(leaves):
+            key = jax.random.fold_in(rng, i + 0x7FFF)
+            amax = jax.lax.pmax(jnp.max(jnp.abs(g)), DATA_AXIS)
+            scale = jnp.maximum(amax / 127.0, jnp.finfo(jnp.float32).tiny)
+            u = jax.random.uniform(key, g.shape, dtype=jnp.float32)
+            q = jnp.clip(
+                jnp.floor(g.astype(jnp.float32) / scale + u), -127, 127
+            ).astype(jnp.int16)
+            s = jax.lax.psum(q, DATA_AXIS)
+            out.append((s.astype(jnp.float32) * scale).astype(g.dtype))
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     def _zero1_update(self, state, local_grads, with_comm: bool):
         """Sharded SGD(momentum) update: reduce_scatter local grads, update

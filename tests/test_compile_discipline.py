@@ -10,6 +10,7 @@ time-noise-driven churn) blows straight past these bounds.
 """
 
 import numpy as np
+import pytest
 
 from dynamic_load_balance_distributeddnn_tpu.config import Config
 from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
@@ -17,6 +18,7 @@ from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInject
 from dynamic_load_balance_distributeddnn_tpu.train import Trainer
 
 
+@pytest.mark.slow
 def test_dbs_recompiles_bounded_by_ladder(tmp_path):
     ws, batch, bucket = 4, 128, 8
     cfg = Config(

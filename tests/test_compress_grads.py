@@ -16,6 +16,7 @@ from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import data_mesh
 from dynamic_load_balance_distributeddnn_tpu.train import Trainer
 
 
+@pytest.mark.slow
 def test_quantized_psum_is_unbiased():
     """E over rounding keys of the dequantized sum == the exact sum."""
     from jax.sharding import PartitionSpec as P
@@ -53,6 +54,7 @@ def test_quantized_psum_is_unbiased():
     np.testing.assert_allclose(trials.mean(axis=0), exact, atol=step * n / 4)
 
 
+@pytest.mark.slow
 def test_compressed_training_tracks_exact(tmp_path):
     def run(compress):
         cfg = Config(

@@ -41,6 +41,7 @@ def _run(bundle, shard):
     return tr, [np.asarray(l) for l in jax.tree_util.tree_leaves(tr.state.params)]
 
 
+@pytest.mark.slow
 def test_sharded_update_matches_replicated(bundle):
     tr_rep, params_rep = _run(bundle, shard=False)
     tr_sh, params_sh = _run(bundle, shard=True)
@@ -73,6 +74,7 @@ def test_shard_update_rejects_dbs():
                model="mnistnet", dataset="mnist")
 
 
+@pytest.mark.slow
 def test_sharded_state_checkpoint_roundtrip(bundle, tmp_path):
     """Orbax must save/restore the sharded trace with its sharding intact and
     training must continue from it (the DBS upgrade path, SURVEY §5.4)."""

@@ -41,6 +41,7 @@ def test_sp_ring_trains_and_records(tmp_path):
         assert len(rec.data[k]) == 2
 
 
+@pytest.mark.slow
 def test_sp_cli_entry(tmp_path):
     from dynamic_load_balance_distributeddnn_tpu import cli
 

@@ -42,6 +42,7 @@ def _run(bundle, chunk, dbs):
     return tr.recorder.data, [np.asarray(l) for l in leaves]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dbs", [False, True], ids=["fused", "elastic"])
 def test_streaming_matches_whole_epoch(bundle, dbs):
     # 512 examples / B=64 -> 8 steps; chunk=3 exercises body+tail windows

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Render recorder artifacts (./statis *.npy/json) as tables.
+
+The reference's workflow dumps per-config numpy dicts (dbs.py:440-442) and
+leaves interpretation to offline plotting; this gives the same data a quick
+terminal view, and computes the dbs-on/off A/B headline when both arms of a
+config are present in the directory.
+
+Usage:
+  python scripts/summarize_statis.py artifacts/acceptance/statis [more dirs/files]
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def load(path):
+    if path.endswith(".npy"):
+        return np.load(path, allow_pickle=True).item()
+    with open(path) as f:
+        return json.load(f)
+
+
+def fmt_run(name, d):
+    rows = []
+    n = len(d.get("epoch", []))
+    for e in range(n):
+        part = np.asarray(d["partition"][e], dtype=float)
+        nt = np.asarray(d["node_time"][e], dtype=float)
+        rows.append(
+            f"  {int(d['epoch'][e]):>3}  {d['train_loss'][e]:>8.4f}  "
+            f"{d['val_loss'][e]:>8.4f}  {d['accuracy'][e]:>7.2f}  "
+            f"{d['train_time'][e]:>8.3f}  {d['wallclock_time'][e]:>9.3f}  "
+            f"{np.array2string(np.round(part, 3), separator=',')}"
+            f"  max/min nt={nt.max() / max(nt.min(), 1e-9):.2f}"
+        )
+    header = (
+        "  ep  train_ls   val_ls      acc   t_node0   wallclock  partition"
+    )
+    return f"{name}\n{header}\n" + "\n".join(rows)
+
+
+def main(argv):
+    paths = []
+    for a in argv or ["./statis"]:
+        if os.path.isdir(a):
+            paths += sorted(
+                os.path.join(a, f) for f in os.listdir(a) if f.endswith(".npy")
+            )
+        elif os.path.exists(a):
+            paths.append(a)
+    runs = {}
+    for p in paths:
+        try:
+            runs[os.path.basename(p)] = load(p)
+        except Exception as e:
+            print(f"skip {p}: {e}", file=sys.stderr)
+    for name, d in runs.items():
+        print(fmt_run(name, d))
+        print()
+    # A/B headline per config: pair -dbs1- with -dbs0-
+    for name, d in runs.items():
+        if "-dbs1-" not in name:
+            continue
+        off_name = name.replace("-dbs1-", "-dbs0-")
+        off = runs.get(off_name)
+        if off is None:
+            continue
+        on_w = np.diff([0.0] + list(d["wallclock_time"]))
+        off_w = np.diff([0.0] + list(off["wallclock_time"]))
+        # steady state: skip the calibration epoch (and first reaction, on-arm)
+        on_s = float(np.min(on_w[2:])) if len(on_w) > 2 else float(on_w[-1])
+        off_s = float(np.min(off_w[1:])) if len(off_w) > 1 else float(off_w[-1])
+        print(
+            f"A/B {name.split('-node')[0]}: steady epoch "
+            f"on={on_s:.3f}s off={off_s:.3f}s speedup={off_s / max(on_s, 1e-9):.2f}x "
+            f"acc on/off={d['accuracy'][-1]:.2f}/{off['accuracy'][-1]:.2f}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -118,6 +118,16 @@ class Config:
                                        # kernel; NOTE: drops attention-prob
                                        # dropout (a semantics change, hence a
                                        # separate knob from use_pallas)
+    fused_dbs: bool = False            # run the DBS balancer on the fused
+                                       # capacity-padded SPMD path: every
+                                       # worker is padded to the max bucketed
+                                       # batch, so ONE compiled scan serves
+                                       # every rebalanced plan (no per-step
+                                       # Python dispatch); the time signal
+                                       # comes from untimed per-worker probe
+                                       # steps. Trades <= capacity_factor x
+                                       # padding FLOPs for zero dispatch.
+                                       # Needs one worker per chip.
     compress_grads: str = ""           # "int8": gradient collective quantized
                                        # to 127 levels (shared pmax scale,
                                        # stochastic rounding — unbiased, no
@@ -166,23 +176,24 @@ class Config:
             raise ValueError("straggler factor list length must equal world_size")
         if self.compress_grads not in ("", "int8"):
             raise ValueError("compress_grads must be '' or 'int8'")
-        if self.compress_grads and self.dynamic_batch_size:
+        if self.compress_grads and self.dynamic_batch_size and not self.fused_dbs:
             raise ValueError(
-                "compress_grads rides the fused uniform-plan path (the "
-                "elastic DBS combine keeps exact f32 gradients)"
+                "compress_grads rides a fused path (the elastic DBS combine "
+                "keeps exact f32 gradients); enable fused_dbs to combine it "
+                "with the balancer"
             )
         if self.compress_grads and self.shard_update:
             raise ValueError("compress_grads and shard_update are exclusive")
-        if self.grad_accum > 1 and self.dynamic_batch_size:
+        if self.grad_accum > 1 and self.dynamic_batch_size and not self.fused_dbs:
             raise ValueError(
-                "grad_accum rides the fused uniform-plan path; the elastic DBS "
-                "path controls memory by shrinking per-worker batches instead"
+                "grad_accum rides a fused path; the elastic DBS path controls "
+                "memory by shrinking per-worker batches instead"
             )
-        if self.shard_update and self.dynamic_batch_size:
+        if self.shard_update and self.dynamic_batch_size and not self.fused_dbs:
             raise ValueError(
-                "shard_update rides the fused uniform-plan path; it cannot be "
-                "combined with dynamic_batch_size (the elastic DBS path keeps "
-                "the replicated update)"
+                "shard_update rides a fused path; combine it with the "
+                "balancer via fused_dbs (the elastic DBS path keeps the "
+                "replicated update)"
             )
 
     def straggler_factors(self) -> List[float]:
@@ -256,6 +267,9 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--bucket", type=int, default=d.bucket)
     p.add_argument("--capacity_factor", type=float, default=d.capacity_factor)
     p.add_argument("--snap_to_bucket", type=str2bool, default=d.snap_to_bucket)
+    p.add_argument("--fused_dbs", type=str2bool, default=d.fused_dbs,
+                   help="DBS on the fused capacity-padded SPMD scan (one "
+                        "compiled step for every plan; probe-measured times).")
     p.add_argument("--compress_grads", type=str, default=d.compress_grads,
                    choices=["", "int8"],
                    help="Quantized gradient collective (stochastic rounding, "

@@ -424,14 +424,16 @@ class Trainer:
         t_epoch = time.perf_counter()
         if (
             cfg.shard_update or cfg.grad_accum > 1 or cfg.compress_grads
-        ) and not self._can_use_fused(plan):
+        ) and not (self._can_use_fused(plan) or self._can_use_fused_dbs(plan)):
             raise RuntimeError(
-                "shard_update/grad_accum require the fused uniform path (one "
-                "worker per device, uniform plan, no compute-mode injection); "
-                "this plan fell back to the elastic path"
+                "shard_update/grad_accum/compress_grads require a fused path "
+                "(one worker per device); this plan fell back to the elastic "
+                "path"
             )
         if self._can_use_fused(plan):
             train_metrics = self._train_epoch_fused(plan, faults, epoch)
+        elif self._can_use_fused_dbs(plan):
+            train_metrics = self._train_epoch_fused(plan, faults, epoch, dbs_probe=True)
         else:
             train_metrics = self._train_epoch_elastic(plan, faults, epoch)
         epoch_wall = (
@@ -475,9 +477,10 @@ class Trainer:
             extras["examples_per_s"] = self.n_train / epoch_wall
         ppe = self._flops_per_padded_example
         if ppe is not None and ppe > 0:
-            self._epoch_flops = ppe * float(
+            padded_examples = train_metrics.get("padded_examples") or float(
                 sum(w.padded_batch * w.steps for w in plan.workers)
             )
+            self._epoch_flops = ppe * padded_examples
             extras["flops_per_epoch"] = self._epoch_flops
             if epoch_wall > 0:
                 from dynamic_load_balance_distributeddnn_tpu.obs.flops import mfu
@@ -523,6 +526,26 @@ class Trainer:
             and not self._needs_iter_cost
         )
 
+    def _can_use_fused_dbs(self, plan) -> bool:
+        """The fused-DBS path (SURVEY §7.3 option b): every worker padded to
+        the same CAPACITY batch so ONE compiled SPMD scan serves every
+        rebalanced plan; per-worker speed is still measured by the standalone
+        (untimed) probe step. Needs one worker per chip."""
+        return (
+            self.cfg.fused_dbs
+            and self.cfg.dynamic_batch_size
+            and self.topology.one_worker_per_device
+            and self.n_dev == self.cfg.world_size
+        )
+
+    @property
+    def _cap_b(self) -> int:
+        """Fused-DBS per-worker capacity width: the largest bucketed batch the
+        balancer can assign (max_share of the global batch)."""
+        cfg = self.cfg
+        max_share = min(1.0, cfg.capacity_factor / cfg.world_size)
+        return -(-int(np.ceil(max_share * cfg.batch_size)) // cfg.bucket) * cfg.bucket
+
     def _chunk_ranges(self, num_steps: int):
         """Step windows of the streaming host path: ``stream_chunk_steps``-sized
         windows (0 = one whole-epoch window). At most two distinct window
@@ -533,12 +556,13 @@ class Trainer:
             return [(0, num_steps)]
         return [(s, min(s + chunk, num_steps)) for s in range(0, num_steps, chunk)]
 
-    def _gather_fused_window(self, plan, s0: int, s1: int):
+    def _gather_fused_window(self, plan, s0: int, s1: int, pad_to=None):
         """Host-side gather of steps [s0, s1): [n, ws*b_pad, ...] numpy arrays
         in the fused path's global layout (worker r owns slice r; each process
-        materializes only its own workers' slice)."""
+        materializes only its own workers' slice). ``pad_to``: fused-DBS
+        capacity width per worker."""
         data = [
-            self._worker_inputs(plan, self.rank_lo + r, s0, s1)
+            self._worker_inputs(plan, self.rank_lo + r, s0, s1, pad_to=pad_to)
             for r in range(self.ws_local)
         ]
         xs = np.concatenate([d[0] for d in data], axis=1)
@@ -566,9 +590,16 @@ class Trainer:
             )
         return xs, ys, ws_
 
-    def _train_epoch_fused(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:
+    def _train_epoch_fused(
+        self, plan, faults: EpochFaults, epoch: int, dbs_probe: bool = False
+    ) -> Dict[str, float]:
+        """``dbs_probe=True``: the fused-DBS mode — every worker padded to the
+        fixed capacity width (one compiled scan for every plan), with the
+        balancer's per-worker time signal measured by the standalone probe
+        step after the epoch (untimed, like the elastic path's probes)."""
         cfg = self.cfg
         self.timekeeper.reset()
+        pad_to = self._cap_b if dbs_probe else None
         from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
 
         mesh = self.mesh
@@ -595,11 +626,13 @@ class Trainer:
         metrics_total = np.zeros(4, dtype=np.float64)
         first_window = None
         with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
-            fut = pool.submit(self._gather_fused_window, plan, *ranges[0])
+            fut = pool.submit(self._gather_fused_window, plan, *ranges[0], pad_to)
             for i, _ in enumerate(ranges):
                 xs, ys, ws_ = self._put_fused_window(*fut.result())
                 if i + 1 < len(ranges):
-                    fut = pool.submit(self._gather_fused_window, plan, *ranges[i + 1])
+                    fut = pool.submit(
+                        self._gather_fused_window, plan, *ranges[i + 1], pad_to
+                    )
                 if first_window is None and self._fused_sync_per_step is None:
                     # retained only on the run's first epoch, for the one-time
                     # sync/FLOPs probes below — not pinned on later epochs
@@ -638,6 +671,26 @@ class Trainer:
             # excluded from the epoch wall so the benchmark's fused-arm
             # wallclock stays comparable to the elastic arm
             probe_overhead = time.perf_counter() - t0
+        if dbs_probe:
+            # The balancer's time signal: per-worker standalone probe steps at
+            # the TRUE (plan-bucketed) shapes, untimed against the epoch wall
+            # — the fused scan itself is one SPMD program with no per-worker
+            # boundary to time.
+            t0 = time.perf_counter()
+            if self.timing_model is None and (
+                cfg.dynamic_batch_size or self._needs_iter_cost
+            ):
+                data = [
+                    self._worker_inputs(plan, self.rank_lo + r, 0, 1)
+                    for r in range(self.ws_local)
+                ]
+                self._probe_workers(plan, data, faults, epoch)
+                self._probes_ran = True
+            if self.timing_model is not None:
+                modeled = np.asarray(self.timing_model(plan), dtype=np.float64)
+                for r in range(cfg.world_size):
+                    self.timekeeper.add_compute(r, modeled[r])
+            probe_overhead += time.perf_counter() - t0
         for r in range(cfg.world_size):
             self.timekeeper.add_injected(r, float(faults.virtual_seconds[r]))
         wloss, loss_sum, count = float(metrics[0]), float(metrics[1]), float(metrics[2])
@@ -646,6 +699,13 @@ class Trainer:
             "wloss": wloss / max(plan.num_steps, 1),
             "sync_time": self._fused_sync_per_step * plan.num_steps,
             "probe_overhead": probe_overhead,
+            # executed padded examples (capacity layout runs cap_b per worker
+            # regardless of the plan's true batches) — MFU accounting
+            "padded_examples": float(
+                cfg.world_size * self._cap_b * plan.num_steps
+            )
+            if dbs_probe
+            else None,
         }
 
     def _probe_fused_sync(self, xs, ys, ws_, slow, seed, reps: int = 3) -> float:
@@ -677,12 +737,24 @@ class Trainer:
         delta = t_full - t_local
         return float(delta) if delta > 0.0 else float(t_psum)
 
-    def _worker_inputs(self, plan, rank: int, s0: int = 0, s1: Optional[int] = None):
+    def _worker_inputs(
+        self,
+        plan,
+        rank: int,
+        s0: int = 0,
+        s1: Optional[int] = None,
+        *,
+        pad_to: Optional[int] = None,
+    ):
         """Materialize one worker's steps [s0, s1) (default: the whole epoch):
         [n, b_pad, ...] batches, labels and per-example weights (the
         weighted-combine contract). The gather runs through the native C++
         runtime when available (multithreaded row pack; runtime/native.py),
-        numpy otherwise — identical results."""
+        numpy otherwise — identical results.
+
+        ``pad_to``: zero-pad the batch axis up to this width (weights 0 on the
+        padding) — the fused-DBS capacity layout, where every worker presents
+        the same static shape regardless of its true batch (SURVEY §7.3)."""
         from dynamic_load_balance_distributeddnn_tpu.runtime import take_rows
 
         idx, mask = plan.epoch_indices(rank, s0, s1)
@@ -700,6 +772,12 @@ class Trainer:
                 for s in range(mask.shape[0])
             ]
         )
+        if pad_to is not None and x.shape[1] < pad_to:
+            extra = pad_to - x.shape[1]
+            pad1 = ((0, 0), (0, extra))
+            x = np.pad(x, pad1 + ((0, 0),) * (x.ndim - 2))
+            y = np.pad(y, pad1[: y.ndim])
+            w = np.pad(w, pad1)
         return x, y, w
 
     def _train_epoch_elastic(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:

@@ -1,0 +1,110 @@
+"""Fused-DBS path: the balancer on a single compiled capacity-padded SPMD
+scan (SURVEY §7.3 option b) must reach the SAME partition plan as the
+elastic path (the solver is deterministic in the time vector) while the
+epoch executes as one scan per window, not per-worker Python dispatch."""
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
+from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return synthetic_dataset("mnist", n_train=1024, n_test=256)
+
+
+def linear_time(plan):
+    return np.array([3.0, 1.0, 1.0, 1.0]) * np.array(
+        [w.batch_size * w.steps for w in plan.workers]
+    )
+
+
+def _run(bundle, fused, **kw):
+    cfg = Config(
+        debug=True,
+        world_size=4,
+        batch_size=128,
+        learning_rate=0.05,
+        epoch_size=4,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=True,
+        fault_tolerance=True,
+        seed=1234,
+        bucket=8,
+        fused_dbs=fused,
+        **kw,
+    )
+    tr = Trainer(
+        cfg,
+        bundle=bundle,
+        injector=StaticStragglerInjector([3.0, 1.0, 1.0, 1.0], mode="virtual"),
+        timing_model=linear_time,
+        log_to_file=False,
+    )
+    rec = tr.run()
+    return tr, rec
+
+
+def test_fused_dbs_matches_elastic_partitions(bundle):
+    tr_e, rec_e = _run(bundle, fused=False)
+    tr_f, rec_f = _run(bundle, fused=True)
+    # deterministic solver + identical modeled time vectors -> identical plans
+    np.testing.assert_allclose(
+        rec_e.data["partition"], rec_f.data["partition"], atol=1e-9
+    )
+    # both learn
+    for rec in (rec_e, rec_f):
+        losses = rec.data["train_loss"]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0] * 1.2
+    # the fused scan actually ran (compiled) and the elastic steps did NOT
+    assert "fused_epoch" in tr_f.steps.__dict__
+    assert tr_f.steps.fused_epoch._cache_size() >= 1
+    assert tr_f.steps.worker_step_acc._cache_size() == 0
+    # capacity layout: one scan geometry for ALL plans (uniform epoch 0 and
+    # every rebalanced epoch share the compiled shapes; body+tail windows)
+    assert tr_f.steps.fused_epoch._cache_size() <= 2
+
+
+@pytest.mark.slow
+def test_fused_dbs_measured_signal_converges(bundle):
+    """No timing model: real probe walls drive the partition (compute-mode
+    injection on the fused program)."""
+    cfg = Config(
+        debug=True,
+        world_size=4,
+        batch_size=128,
+        learning_rate=0.05,
+        epoch_size=5,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=True,
+        fault_tolerance=True,
+        fault_mode="compute",
+        seed=77,
+        bucket=8,
+        fused_dbs=True,
+        time_smoothing=0.3,
+    )
+    tr = Trainer(
+        cfg,
+        bundle=bundle,
+        injector=StaticStragglerInjector([3.0, 1.0, 1.0, 1.0], mode="compute"),
+        log_to_file=False,
+    )
+    rec = tr.run()
+    final = np.array(rec.data["partition"][-1])
+    assert final[0] < 0.25 - 0.04, f"straggler share did not drop: {rec.data['partition']}"
+    assert final.sum() == pytest.approx(1.0)
+
+
+@pytest.mark.slow
+def test_fused_dbs_with_compressed_collective(bundle):
+    """Feature composition: balancer + int8 collective on the fused scan."""
+    tr, rec = _run(bundle, fused=True, compress_grads="int8")
+    losses = rec.data["train_loss"]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0] * 1.2

@@ -24,6 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from dynamic_load_balance_distributeddnn_tpu.ops import pallas as _pk
 
@@ -68,14 +69,14 @@ def _fwd_impl(x3, scale, bias, groups: int, eps: float, interpret: bool):
         kernel,
         grid=(b,),
         in_specs=[
-            pl.BlockSpec((1, s_dim, c), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, c), lambda i: (0, 0)),
-            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, s_dim, c), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, s_dim, c), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, s_dim, c), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, groups), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, s_dim, c), x3.dtype),

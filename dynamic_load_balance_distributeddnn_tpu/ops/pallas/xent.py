@@ -20,6 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from dynamic_load_balance_distributeddnn_tpu.ops import pallas as _pk
 
@@ -63,10 +64,10 @@ def _fwd_impl(logits, labels2, interpret):
         _xent_fwd_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_ROW_BLOCK, v), lambda i: (i, 0)),
-            pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_BLOCK, v), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((r, 1), jnp.float32),
         interpret=interpret,
     )(logits, labels2)
@@ -89,11 +90,11 @@ def _fused_xent_bwd(interpret, res, dloss):
         _xent_bwd_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_ROW_BLOCK, v), lambda i: (i, 0)),
-            pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0)),
-            pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_BLOCK, v), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((_ROW_BLOCK, v), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((_ROW_BLOCK, v), lambda i: (i, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((r, v), logits.dtype),
         interpret=interpret,
     )(logits, labels2, dloss)

@@ -100,10 +100,18 @@ class EpochPlan:
         idx = np.zeros((n, w.padded_batch), dtype=np.int64)
         mask = np.zeros((n, w.padded_batch), dtype=bool)
         b = max(w.batch_size, 1)
-        for i, s in enumerate(range(s0, min(s1, w.steps))):
-            chunk = w.indices[s * b : (s + 1) * b]
-            idx[i, : len(chunk)] = chunk
-            mask[i, : len(chunk)] = True
+        n_real = max(min(s1, w.steps) - s0, 0)
+        if n_real > 0:
+            # vectorized: owned indices [s0*b, ...) laid out row-major into
+            # [n_real, b] (the tail row may be short), no per-step Python
+            flat = w.indices[s0 * b : (s0 + n_real) * b]
+            full_rows, rem = divmod(len(flat), b)
+            if full_rows:
+                idx[:full_rows, :b] = flat[: full_rows * b].reshape(full_rows, b)
+                mask[:full_rows, :b] = True
+            if rem:
+                idx[full_rows, :rem] = flat[full_rows * b :]
+                mask[full_rows, :rem] = True
         return idx, mask
 
 

@@ -54,6 +54,12 @@ class LMTrainer(Trainer):
 
     def _setup_data(self, bundle) -> None:
         cfg = self.cfg
+        if cfg.fused_dbs:
+            raise ValueError(
+                "fused_dbs is the vision path's capacity layout; the LM's "
+                "column-count batches use the elastic path (or --seq_parallel "
+                "for the fused long-context mode)"
+            )
         if bundle is not None:
             self.corpus = bundle  # tests may inject a Corpus directly
         else:

@@ -118,6 +118,11 @@ class Config:
                                        # kernel; NOTE: drops attention-prob
                                        # dropout (a semantics change, hence a
                                        # separate knob from use_pallas)
+    remat: bool = False                # jax.checkpoint the training forward:
+                                       # activations recomputed in the
+                                       # backward (exact math; HBM for ~1/3
+                                       # extra FLOPs — the standard TPU
+                                       # memory lever)
     fused_dbs: bool = False            # run the DBS balancer on the fused
                                        # capacity-padded SPMD path: every
                                        # worker is padded to the max bucketed
@@ -267,6 +272,9 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--bucket", type=int, default=d.bucket)
     p.add_argument("--capacity_factor", type=float, default=d.capacity_factor)
     p.add_argument("--snap_to_bucket", type=str2bool, default=d.snap_to_bucket)
+    p.add_argument("--remat", type=str2bool, default=d.remat,
+                   help="Rematerialize activations in the backward "
+                        "(jax.checkpoint; exact, saves HBM).")
     p.add_argument("--fused_dbs", type=str2bool, default=d.fused_dbs,
                    help="DBS on the fused capacity-padded SPMD scan (one "
                         "compiled step for every plan; probe-measured times).")

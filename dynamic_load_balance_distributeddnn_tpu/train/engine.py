@@ -244,6 +244,7 @@ class Trainer:
             shard_update=cfg.shard_update,
             grad_accum=cfg.grad_accum,
             compress_grads=cfg.compress_grads,
+            remat=cfg.remat,
         )
 
     def _build_plan(self, epoch: int, batch_sizes: np.ndarray):

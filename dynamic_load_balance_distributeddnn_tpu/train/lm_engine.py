@@ -110,6 +110,7 @@ class LMTrainer(Trainer):
             compute_dtype=jnp.bfloat16 if cfg.precision == "bfloat16" else None,
             use_pallas=cfg.use_pallas,
             grad_accum=cfg.grad_accum,
+            remat=cfg.remat,
         )
 
     def _dummy_batch(self, b: int):
@@ -154,7 +155,11 @@ class LMTrainer(Trainer):
             global_batch=cfg.batch_size,
         )
 
-    def _worker_inputs(self, plan: EpochPlan, rank: int, s0: int = 0, s1=None):
+    def _worker_inputs(
+        self, plan: EpochPlan, rank: int, s0: int = 0, s1=None, *, pad_to=None
+    ):
+        # pad_to is the vision fused-DBS capacity layout — unused here (the
+        # LM rejects fused_dbs in _setup_data), accepted for signature parity
         cfg = self.cfg
         w = plan.workers[rank]
         if len(w.indices):

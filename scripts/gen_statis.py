@@ -90,6 +90,7 @@ def main() -> int:
 
     only = os.environ.get("STATIS_ONLY")
     names = [n for n in CONFIGS if not only or n in only.split(",")]
+    vision_b = os.environ.get("STATIS_VISION_B")  # reduced-scale CPU insurance
     manifest = {
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
@@ -99,7 +100,10 @@ def main() -> int:
         "runs": {},
     }
     for name in names:
-        base = CONFIGS[name]
+        base = list(CONFIGS[name])
+        if vision_b and name != "c5_transformer":
+            bi = base.index("-b")
+            base[bi + 1] = vision_b
         n_train = LM_NTRAIN if name == "c5_transformer" else NTRAIN
         for dbs in ("true", "false"):
             args = base + [

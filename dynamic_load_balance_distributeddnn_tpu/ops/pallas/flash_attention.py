@@ -97,7 +97,10 @@ def _attn_fwd_kernel(
     def _finish():
         l_safe = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, 0] + jnp.log(l_safe[:, 0])).astype(jnp.float32)
+        # lse is [bh, 1, t_pad]: the singleton sublane keeps the block's
+        # last-two dims (1, block_q) legal under Mosaic tiling (sublane dim
+        # equals the array dim; block_q is lane-aligned by _tpu_block_sizes)
+        lse_ref[0, 0] = (m_ref[:, 0] + jnp.log(l_safe[:, 0])).astype(jnp.float32)
 
 
 def _attn_bwd_dkv_kernel(
@@ -119,8 +122,8 @@ def _attn_bwd_dkv_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0].astype(jnp.float32)
-        delta = delta_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0].astype(jnp.float32)
+        delta = delta_ref[0, 0].astype(jnp.float32)
         s = _scores(q, k, scale, i, j, block_q, block_k, causal, t_real)
         p = jnp.exp(s - lse[:, None])
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -164,8 +167,8 @@ def _attn_bwd_dq_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0].astype(jnp.float32)
-        delta = delta_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0].astype(jnp.float32)
+        delta = delta_ref[0, 0].astype(jnp.float32)
         s = _scores(q, k, scale, i, j, block_q, block_k, causal, t_real)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
@@ -229,11 +232,11 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
-            jax.ShapeDtypeStruct((bh, t_pad), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, t_pad), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
@@ -263,9 +266,10 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
     bh, t_real, d_real = o.shape
     scale = 1.0 / (d_real ** 0.5)
     dop = _pad_to(_pad_to(do, 2, 128), 1, t_pad)  # same policy as _fwd_impl
-    # delta = rowsum(dO ∘ O) — one bandwidth pass, fused by XLA
+    # delta = rowsum(dO ∘ O) — one bandwidth pass, fused by XLA; carried as
+    # [bh, 1, t_pad] (same singleton-sublane layout as lse) for legal tiling
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    delta = _pad_to(delta, 1, t_pad)
+    delta = _pad_to(delta, 1, t_pad)[:, None, :]
 
     nk = t_pad // block_k
     nq = t_pad // block_q
@@ -284,8 +288,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, d_pad), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d_pad), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM),
@@ -310,8 +314,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), qp.dtype),
@@ -332,7 +336,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def _tpu_block_sizes(t16: int, block_q: int, block_k: int) -> "tuple[int, int]":
     """Snap block sizes to Mosaic lane-tiling-safe values for real-TPU runs.
 
-    The lse output block is ``(1, block_q)`` — block_q sits in the LANE
+    The lse/delta block is ``(1, 1, block_q)`` — block_q sits in the LANE
     dimension, so a block smaller than the padded time axis must be a
     multiple of 128 lanes. Short sequences (t16 < 128) use the full width
     (block == padded array dim, which Mosaic masks internally); otherwise

@@ -118,7 +118,10 @@ class StepLibrary:
             self._cast_compute(p), xx, train=True, rngs={"dropout": rng}
         )
         if self.remat:
-            return jax.checkpoint(apply)(params, x)
+            # prevent_cse=False: safe (and recommended) because the remat'd
+            # forward only ever runs under jit, including the grad-accum scan
+            # body — avoids optimization barriers in the hot loop.
+            return jax.checkpoint(apply, prevent_cse=False)(params, x)
         return apply(params, x)
 
     def _cast_compute(self, tree):
@@ -145,7 +148,6 @@ class StepLibrary:
 
     def _build(self):
         spec = self.spec
-        apply_fn = spec.module.apply
 
         def local_grads(params, x, y, w, rng, slow_iters, train_prep_rng):
             """Shared forward/backward for one worker's (padded) batch."""
@@ -263,7 +265,6 @@ class StepLibrary:
         fused-path analogue of the reference's per-step allreduce wait meter
         (dbs.py:297-299)."""
         spec = self.spec
-        apply_fn = spec.module.apply
         tx = self.tx
         idx = jax.lax.axis_index(DATA_AXIS)
         rng = jax.random.fold_in(

@@ -21,7 +21,7 @@ from dynamic_load_balance_distributeddnn_tpu.train.state import create_state, ma
 from dynamic_load_balance_distributeddnn_tpu.train.steps import StepLibrary
 
 
-def _fused_once(grad_accum):
+def _fused_once(grad_accum, **lib_kwargs):
     mesh = data_mesh()
     n = len(mesh.devices.flat)
     spec = build_model(
@@ -37,7 +37,7 @@ def _fused_once(grad_accum):
     state = create_state(
         spec.module, toks[:1], tx, seed=3, sharding=replicated_sharding(mesh)
     )
-    lib = StepLibrary(spec, mesh, tx, grad_accum=grad_accum)
+    lib = StepLibrary(spec, mesh, tx, grad_accum=grad_accum, **lib_kwargs)
     x = jax.device_put(toks, batch_sharding(mesh, 2))
     y = jax.device_put(tgts, batch_sharding(mesh, 2))
     ws = jax.device_put(w, batch_sharding(mesh, 2))
@@ -87,34 +87,7 @@ def test_remat_exact_vs_plain():
     """jax.checkpoint changes scheduling, not math: same params after a
     fused step with and without remat."""
     params_plain, metrics_plain = _fused_once(1)
-    params_remat, metrics_remat = _fused_once_remat()
+    params_remat, metrics_remat = _fused_once(1, remat=True)
     np.testing.assert_allclose(metrics_plain[:3], metrics_remat[:3], rtol=1e-6)
     for a, b in zip(params_plain, params_remat):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8)
-
-
-def _fused_once_remat():
-    mesh = data_mesh()
-    n = len(mesh.devices.flat)
-    spec = build_model(
-        "transformer", ntoken=50, ninp=16, nhead=2, nhid=16, nlayers=1, dropout=0.0
-    )
-    tx = make_optimizer(0.05, 0.9)
-    rng = np.random.RandomState(0)
-    b = n * 8
-    toks = jnp.asarray(rng.randint(0, 50, (b, 12)), jnp.int32)
-    tgts = jnp.asarray(rng.randint(0, 50, (b, 12)), jnp.int32)
-    w = jnp.asarray(np.full((b, 12), 1.0 / (b * 12), np.float32))
-    state = create_state(
-        spec.module, toks[:1], tx, seed=3, sharding=replicated_sharding(mesh)
-    )
-    lib = StepLibrary(spec, mesh, tx, remat=True)
-    x = jax.device_put(toks, batch_sharding(mesh, 2))
-    y = jax.device_put(tgts, batch_sharding(mesh, 2))
-    ws = jax.device_put(w, batch_sharding(mesh, 2))
-    slow = jax.device_put(np.zeros((n,), np.int32), batch_sharding(mesh, 1))
-    state, metrics = lib.fused_step(state, x, y, ws, slow, jnp.int32(0))
-    return (
-        [np.asarray(l) for l in jax.tree_util.tree_leaves(state.params)],
-        np.asarray(metrics),
-    )

@@ -54,12 +54,6 @@ class LMTrainer(Trainer):
 
     def _setup_data(self, bundle) -> None:
         cfg = self.cfg
-        if cfg.fused_dbs:
-            raise ValueError(
-                "fused_dbs is the vision path's capacity layout; the LM's "
-                "column-count batches use the elastic path (or --seq_parallel "
-                "for the fused long-context mode)"
-            )
         if bundle is not None:
             self.corpus = bundle  # tests may inject a Corpus directly
         else:
@@ -158,8 +152,26 @@ class LMTrainer(Trainer):
     def _worker_inputs(
         self, plan: EpochPlan, rank: int, s0: int = 0, s1=None, *, pad_to=None
     ):
-        # pad_to is the vision fused-DBS capacity layout — unused here (the
-        # LM rejects fused_dbs in _setup_data), accepted for signature parity
+        # pad_to: the fused-DBS capacity layout — every worker presents
+        # ``cap`` columns (padding masked to zero weight) so one compiled
+        # scan serves every rebalanced plan, exactly as in the vision path.
+        #
+        # The epoch's windows are plan-deterministic, so they are built ONCE
+        # per (epoch, rank, pad) and the chunked fused gather / probe calls
+        # slice the cached arrays — token windows are small (the folded
+        # stream), so whole-epoch residency is cheap, unlike images.
+        if getattr(self, "_win_cache_epoch", None) != plan.epoch:
+            self._win_cache_epoch = plan.epoch
+            self._win_cache = {}
+        key = (rank, pad_to)
+        if key not in self._win_cache:
+            self._win_cache[key] = self._build_windows(plan, rank, pad_to)
+        x, y, weights = self._win_cache[key]
+        if s1 is None:
+            s1 = plan.num_steps
+        return x[s0:s1], y[s0:s1], weights[s0:s1]
+
+    def _build_windows(self, plan: EpochPlan, rank: int, pad_to):
         cfg = self.cfg
         w = plan.workers[rank]
         if len(w.indices):
@@ -167,7 +179,9 @@ class LMTrainer(Trainer):
         else:
             slice_tokens = np.zeros(0, dtype=np.int32)
         data = batchify(slice_tokens, w.batch_size)
-        x, y, m = bptt_windows(data, cfg.bptt, pad_bsz=w.padded_batch)
+        x, y, m = bptt_windows(
+            data, cfg.bptt, pad_bsz=pad_to if pad_to is not None else w.padded_batch
+        )
         # pad the step axis to the plan-wide count with fully masked windows
         if x.shape[0] < plan.num_steps:
             extra = plan.num_steps - x.shape[0]
@@ -184,12 +198,7 @@ class LMTrainer(Trainer):
         weights = m * (
             p_r / np.maximum(tok_counts, 1.0)[:, None, None]
         ).astype(np.float32)
-        # Streaming window slice: token windows derive from the (small) folded
-        # stream, so the LM builds them all and returns the requested rows —
-        # the step-range contract without image-scale memory concerns.
-        if s1 is None:
-            s1 = plan.num_steps
-        return x[s0:s1], y[s0:s1], weights[s0:s1]
+        return x, y, weights
 
     # ------------------------------------------------------------- validate
 

@@ -108,3 +108,60 @@ def test_fused_dbs_with_compressed_collective(bundle):
     tr, rec = _run(bundle, fused=True, compress_grads="int8")
     losses = rec.data["train_loss"]
     assert np.isfinite(losses).all() and losses[-1] < losses[0] * 1.2
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    from dynamic_load_balance_distributeddnn_tpu.data.corpus import Corpus
+
+    d = tmp_path_factory.mktemp("corpus")
+    rng = np.random.RandomState(0)
+    words = [f"tok{i}" for i in range(50)]
+    text = "\n".join(" ".join(rng.choice(words, size=12)) for _ in range(400))
+    (d / "train.txt").write_text(text)
+    (d / "valid.txt").write_text(text[:2000])
+    (d / "test.txt").write_text(text[:2000])
+    return Corpus(str(d))
+
+
+@pytest.mark.slow
+def test_fused_dbs_lm_matches_elastic_partitions(corpus):
+    """The capacity layout is model-agnostic: the LM's column-count batches
+    pad to the same cap width, so its balancer trajectory on the fused scan
+    matches the elastic path's exactly."""
+    from dynamic_load_balance_distributeddnn_tpu.train.lm_engine import LMTrainer
+
+    def run_lm(fused):
+        cfg = Config(
+            debug=True,
+            world_size=4,
+            batch_size=40,
+            learning_rate=0.5,
+            epoch_size=3,
+            dataset="wikitext2",
+            model="transformer",
+            dynamic_batch_size=True,
+            fault_tolerance=True,
+            bucket=4,
+            bptt=16,
+            fused_dbs=fused,
+        )
+        tr = LMTrainer(
+            cfg,
+            bundle=corpus,
+            injector=StaticStragglerInjector([3.0, 1.0, 1.0, 1.0], mode="virtual"),
+            timing_model=linear_time,
+            log_to_file=False,
+        )
+        rec = tr.run()
+        return tr, rec
+
+    tr_e, rec_e = run_lm(False)
+    tr_f, rec_f = run_lm(True)
+    np.testing.assert_allclose(
+        rec_e.data["partition"], rec_f.data["partition"], atol=1e-9
+    )
+    for rec in (rec_e, rec_f):
+        assert np.isfinite(rec.data["train_loss"]).all()
+    assert tr_f.steps.fused_epoch._cache_size() >= 1
+    assert tr_f.steps.worker_step_acc._cache_size() == 0

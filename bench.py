@@ -173,8 +173,9 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
     _write_atomic(out_path, out)
 
     # epoch 0 calibrates (no injection), epoch 1 is the first injected epoch;
-    # the off arm needs fewer epochs since it never rebalances
-    for arm, dbs_on, n_ep in (("off", False, max(3, epochs - 2)), ("on", True, epochs)):
+    # the off arm runs one epoch fewer (no rebalance to converge) so the two
+    # arms' steady windows have comparable sample counts for the min
+    for arm, dbs_on, n_ep in (("off", False, max(3, epochs - 1)), ("on", True, epochs)):
         if len(resume.get(arm, [])) >= n_ep:
             out[arm] = resume[arm][:n_ep]
             for k, v in resume.get("instr", {}).items():
@@ -254,8 +255,15 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
 
 
 def _steady(walls_off, walls_on):
-    """Steady-state epoch walls: skip the calibration epoch on the off arm
-    and calibration+first-reaction on the on arm."""
+    """Steady-state epoch walls. Off arm: skip epoch 0 (calibration, no
+    injection). On arm: skip epoch 0 AND epoch 1 — epoch 1 is injected but
+    still on uniform shares (its rebalance consumed epoch-0 uninjected
+    times), so it is an off-arm epoch in disguise. With the off arm running
+    one epoch fewer (run_arms), both windows hold epochs-2 samples. Min (not
+    mean) because host/tunnel jitter only ever ADDS time; the min
+    approximates the uncontended wall. Injection strength is constant across
+    counted epochs because the injector calibrates to the requested factors
+    BEFORE the first injected epoch (engine._probe_workers)."""
     import numpy as np
 
     off = float(np.min(walls_off[1:])) if len(walls_off) >= 2 else None
@@ -333,7 +341,7 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
     best_quality = (-1, -1)  # (epochs salvaged, n_train) — bigger is better
     n_train = int(os.environ.get("BENCH_NTRAIN", 12800))
     epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
-    arm_needs = {"off": max(3, epochs - 2), "on": epochs}  # mirrors run_arms
+    arm_needs = {"off": max(3, epochs - 1), "on": epochs}  # mirrors run_arms
     resume_path = ""
     shrink = 0
     for attempt in range(retries):

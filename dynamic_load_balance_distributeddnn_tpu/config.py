@@ -165,6 +165,20 @@ class Config:
                                        # inside a timed epoch (benchmarks set
                                        # this; the persistent compile cache
                                        # makes it cheap on reruns)
+    device_cache: str = "auto"         # "auto"|"on"|"off": keep the train
+                                       # arrays resident in HBM and feed each
+                                       # epoch by INDEX (on-device gather in
+                                       # the compiled step). The reference
+                                       # rebuilds a DataLoader per epoch
+                                       # (dbs.py:394-395); the TPU-native
+                                       # equivalent makes the per-epoch
+                                       # reshard an index permutation — per
+                                       # epoch host->device traffic drops
+                                       # from the whole dataset to [steps,
+                                       # batch] int32. auto = on when the
+                                       # arrays fit device_cache_mb, vision
+                                       # path, single process.
+    device_cache_mb: int = 512         # HBM budget for the device cache
 
     def __post_init__(self):
         if self.model not in MODELS:
@@ -181,6 +195,8 @@ class Config:
             raise ValueError("straggler factor list length must equal world_size")
         if self.compress_grads not in ("", "int8"):
             raise ValueError("compress_grads must be '' or 'int8'")
+        if self.device_cache not in ("auto", "on", "off"):
+            raise ValueError("device_cache must be 'auto', 'on' or 'off'")
         if self.compress_grads and self.dynamic_batch_size and not self.fused_dbs:
             raise ValueError(
                 "compress_grads rides a fused path (the elastic DBS combine "
@@ -314,6 +330,12 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_pallas", type=str2bool, default=d.use_pallas)
     p.add_argument("--use_flash_attention", type=str2bool, default=d.use_flash_attention)
     p.add_argument("--warm_start", type=str2bool, default=d.warm_start)
+    p.add_argument("--device_cache", type=str, default=d.device_cache,
+                   choices=["auto", "on", "off"],
+                   help="Keep train arrays HBM-resident and feed epochs by "
+                        "index (on-device gather): per-epoch reshard costs an "
+                        "index upload instead of re-transferring the dataset.")
+    p.add_argument("--device_cache_mb", type=int, default=d.device_cache_mb)
     return p
 
 

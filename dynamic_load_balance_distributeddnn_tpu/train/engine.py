@@ -179,6 +179,7 @@ class Trainer:
         # standalone calibration, then closed-loop-corrected from realized
         # probe deltas (per-process — hosts may genuinely differ).
         self._iter_cost_s: Optional[float] = None
+        self._iter_cost_calibrated = False
         self.timekeeper = TimeKeeper(cfg.world_size)
         self.total_wallclock = 0.0
         # Fused-path sync-time meter: seconds of collective cost per step,
@@ -191,6 +192,77 @@ class Trainer:
         self._epoch_flops: Optional[float] = None
         self._warmed = False
         self._probes_ran = False  # replicated across processes by construction
+        # Device-resident data cache (config.device_cache): train arrays live
+        # in HBM and epochs are fed by index (on-device gather), so the
+        # per-epoch reshard uploads [steps, batch] int32 instead of the
+        # dataset. Lazily materialized per path (replicated for the fused
+        # scan; one copy per used device for the elastic executables).
+        self._use_device_cache = self._decide_device_cache()
+        self._cache_repl = None
+        self._cache_dev: Dict[int, tuple] = {}
+        if self._use_device_cache:
+            mb = (self.bundle.train_x.nbytes + self.bundle.train_y.nbytes) / 1e6
+            self.logger.info(
+                f"device cache: train arrays HBM-resident ({mb:.1f} MB), "
+                "epochs fed by index"
+            )
+
+    def _decide_device_cache(self) -> bool:
+        cfg = self.cfg
+        if cfg.device_cache == "off":
+            return False
+        tx = getattr(self.bundle, "train_x", None) if self.bundle is not None else None
+        ty = getattr(self.bundle, "train_y", None) if self.bundle is not None else None
+        if tx is None or ty is None:
+            # tokens path (LM folds its stream into windows host-side)
+            if cfg.device_cache == "on":
+                self.logger.warning("device_cache=on ignored: no cacheable train arrays")
+            return False
+        if self.n_proc > 1:
+            # multi-host replication of the cache is future work; the
+            # materialized path remains correct there
+            if cfg.device_cache == "on":
+                self.logger.warning("device_cache=on ignored: multi-process run")
+            return False
+        if cfg.device_cache == "on":
+            return True
+        return tx.nbytes + ty.nbytes <= cfg.device_cache_mb * 1_000_000
+
+    def _device_cache_replicated(self):
+        if self._cache_repl is None:
+            self._cache_repl = (
+                jax.device_put(self.bundle.train_x, replicated_sharding(self.mesh)),
+                jax.device_put(
+                    np.asarray(self.bundle.train_y, dtype=np.int32),
+                    replicated_sharding(self.mesh),
+                ),
+            )
+        return self._cache_repl
+
+    def _device_cache_for(self, d: int):
+        if d not in self._cache_dev:
+            dev = self.topology.devices[d]
+            if self._cache_repl is not None:
+                # the replicated copy already has a buffer on this device —
+                # reference it instead of uploading a second copy (keeps HBM
+                # residency at one dataset per device in fused-DBS mode,
+                # where both the scan and the probes need the cache)
+                self._cache_dev[d] = tuple(
+                    next(
+                        s.data
+                        for s in arr.addressable_shards
+                        if s.device == dev
+                    )
+                    for arr in self._cache_repl
+                )
+            else:
+                self._cache_dev[d] = (
+                    jax.device_put(self.bundle.train_x, dev),
+                    jax.device_put(
+                        np.asarray(self.bundle.train_y, dtype=np.int32), dev
+                    ),
+                )
+        return self._cache_dev[d]
 
     # -------------------------------------------------------------- set-up
     # Subclass hooks: the LM trainer (train/lm_engine.py) overrides these.
@@ -287,20 +359,34 @@ class Trainer:
         views = shard_views(self.state.params, self.topology.devices)
         # the accumulate variant only runs where workers share a device
         warm_acc = any(len(g) > 1 for g in self.topology.groups.values())
+        use_cache = self._use_device_cache
         for d in self.topology.used_device_indices:
             dev = self.topology.devices[d]
+            cache = self._device_cache_for(d) if use_cache else ()
             for b in ladder:
                 x, y, w = self._dummy_batch(b)
-                args = (
-                    jax.device_put(x, dev),
-                    jax.device_put(y, dev),
-                    jax.device_put(w, dev),
-                    jax.device_put(key, dev),
-                    jax.device_put(slow, dev),
-                )
-                acc, aux = self.steps.worker_step_first(views[d], *args)
+                if use_cache:
+                    args = cache + (
+                        jax.device_put(np.zeros((b,), np.int32), dev),
+                        jax.device_put(w, dev),
+                        jax.device_put(key, dev),
+                        jax.device_put(slow, dev),
+                    )
+                    step_first = self.steps.worker_step_first_idx
+                    step_acc = self.steps.worker_step_acc_idx
+                else:
+                    args = (
+                        jax.device_put(x, dev),
+                        jax.device_put(y, dev),
+                        jax.device_put(w, dev),
+                        jax.device_put(key, dev),
+                        jax.device_put(slow, dev),
+                    )
+                    step_first = self.steps.worker_step_first
+                    step_acc = self.steps.worker_step_acc
+                acc, aux = step_first(views[d], *args)
                 if warm_acc:
-                    acc, aux = self.steps.worker_step_acc(views[d], acc, *args)
+                    acc, aux = step_acc(views[d], acc, *args)
                 jax.block_until_ready(aux)
         self.logger.info(
             f"Warm start: compiled {len(ladder)} batch shapes "
@@ -557,39 +643,40 @@ class Trainer:
             return [(0, num_steps)]
         return [(s, min(s + chunk, num_steps)) for s in range(0, num_steps, chunk)]
 
-    def _gather_fused_window(self, plan, s0: int, s1: int, pad_to=None):
+    def _gather_fused_window(self, plan, s0: int, s1: int, pad_to=None,
+                             as_indices: bool = False):
         """Host-side gather of steps [s0, s1): [n, ws*b_pad, ...] numpy arrays
         in the fused path's global layout (worker r owns slice r; each process
         materializes only its own workers' slice). ``pad_to``: fused-DBS
-        capacity width per worker."""
+        capacity width per worker. ``as_indices``: device-cache mode — the
+        window is (idx, w) only; rows gather on device."""
         data = [
-            self._worker_inputs(plan, self.rank_lo + r, s0, s1, pad_to=pad_to)
+            self._worker_inputs(
+                plan, self.rank_lo + r, s0, s1, pad_to=pad_to,
+                as_indices=as_indices,
+            )
             for r in range(self.ws_local)
         ]
-        xs = np.concatenate([d[0] for d in data], axis=1)
-        ys = np.concatenate([d[1] for d in data], axis=1)
-        ws_ = np.concatenate([d[2] for d in data], axis=1)
-        return xs, ys, ws_
+        return tuple(
+            np.concatenate([d[i] for d in data], axis=1)
+            for i in range(len(data[0]))
+        )
 
-    def _put_fused_window(self, xs, ys, ws_):
+    def _put_fused_window(self, *arrays):
         from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
 
         mesh = self.mesh
         if self.n_proc == 1:
-            xs = jax.device_put(xs, batch_sharding(mesh, xs.ndim, axis_dim=1))
-            ys = jax.device_put(ys, batch_sharding(mesh, ys.ndim, axis_dim=1))
-            ws_ = jax.device_put(ws_, batch_sharding(mesh, ws_.ndim, axis_dim=1))
-        else:
-            xs = jax.make_array_from_process_local_data(
-                batch_sharding(mesh, xs.ndim, axis_dim=1), xs
+            return tuple(
+                jax.device_put(a, batch_sharding(mesh, a.ndim, axis_dim=1))
+                for a in arrays
             )
-            ys = jax.make_array_from_process_local_data(
-                batch_sharding(mesh, ys.ndim, axis_dim=1), ys
+        return tuple(
+            jax.make_array_from_process_local_data(
+                batch_sharding(mesh, a.ndim, axis_dim=1), a
             )
-            ws_ = jax.make_array_from_process_local_data(
-                batch_sharding(mesh, ws_.ndim, axis_dim=1), ws_
-            )
-        return xs, ys, ws_
+            for a in arrays
+        )
 
     def _train_epoch_fused(
         self, plan, faults: EpochFaults, epoch: int, dbs_probe: bool = False
@@ -626,27 +713,46 @@ class Trainer:
         ranges = self._chunk_ranges(plan.num_steps)
         metrics_total = np.zeros(4, dtype=np.float64)
         first_window = None
+        use_cache = self._use_device_cache
+        if use_cache:
+            cache_x, cache_y = self._device_cache_replicated()
         with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
-            fut = pool.submit(self._gather_fused_window, plan, *ranges[0], pad_to)
+            fut = pool.submit(
+                self._gather_fused_window, plan, *ranges[0], pad_to, use_cache
+            )
             for i, _ in enumerate(ranges):
-                xs, ys, ws_ = self._put_fused_window(*fut.result())
+                win = self._put_fused_window(*fut.result())
                 if i + 1 < len(ranges):
                     fut = pool.submit(
-                        self._gather_fused_window, plan, *ranges[i + 1], pad_to
+                        self._gather_fused_window, plan, *ranges[i + 1], pad_to,
+                        use_cache,
                     )
-                if first_window is None and self._fused_sync_per_step is None:
-                    # retained only on the run's first epoch, for the one-time
-                    # sync/FLOPs probes below — not pinned on later epochs
-                    first_window = (xs, ys, ws_)
-                self.state, metrics = self.steps.fused_epoch(
-                    self.state, xs, ys, ws_, slow, seed
-                )
+                if use_cache:
+                    idxs, ws_ = win
+                    self.state, metrics = self.steps.fused_epoch_idx(
+                        self.state, cache_x, cache_y, idxs, ws_, slow, seed
+                    )
+                else:
+                    xs, ys, ws_ = win
+                    if first_window is None and self._fused_sync_per_step is None:
+                        # retained only on the run's first epoch, for the
+                        # one-time sync/FLOPs probes below — not pinned later
+                        first_window = (xs, ys, ws_)
+                    self.state, metrics = self.steps.fused_epoch(
+                        self.state, xs, ys, ws_, slow, seed
+                    )
                 metrics_total += np.asarray(jax.block_until_ready(metrics))
         metrics = metrics_total
         probe_overhead = 0.0
         if self._fused_sync_per_step is None:
-            xs, ys, ws_ = first_window
             t0 = time.perf_counter()
+            if first_window is None:
+                # device-cache mode: materialize ONE step's batches for the
+                # one-time sync/FLOPs probes (probe-overhead time, not wall)
+                first_window = self._put_fused_window(
+                    *self._gather_fused_window(plan, 0, 1, pad_to)
+                )
+            xs, ys, ws_ = first_window
             self._fused_sync_per_step = self._probe_fused_sync(
                 xs, ys, ws_, slow, jnp.int32(cfg.seed * 31 + epoch)
             )
@@ -682,7 +788,10 @@ class Trainer:
                 cfg.dynamic_batch_size or self._needs_iter_cost
             ):
                 data = [
-                    self._worker_inputs(plan, self.rank_lo + r, 0, 1)
+                    self._worker_inputs(
+                        plan, self.rank_lo + r, 0, 1,
+                        as_indices=self._use_device_cache,
+                    )
                     for r in range(self.ws_local)
                 ]
                 self._probe_workers(plan, data, faults, epoch)
@@ -746,6 +855,7 @@ class Trainer:
         s1: Optional[int] = None,
         *,
         pad_to: Optional[int] = None,
+        as_indices: bool = False,
     ):
         """Materialize one worker's steps [s0, s1) (default: the whole epoch):
         [n, b_pad, ...] batches, labels and per-example weights (the
@@ -755,12 +865,14 @@ class Trainer:
 
         ``pad_to``: zero-pad the batch axis up to this width (weights 0 on the
         padding) — the fused-DBS capacity layout, where every worker presents
-        the same static shape regardless of its true batch (SURVEY §7.3)."""
+        the same static shape regardless of its true batch (SURVEY §7.3).
+
+        ``as_indices``: device-cache mode — return ``(idx_i32, w)`` and let
+        the compiled step gather the rows from the HBM-resident arrays
+        (identical rows and weights; the host-side row pack is skipped)."""
         from dynamic_load_balance_distributeddnn_tpu.runtime import take_rows
 
         idx, mask = plan.epoch_indices(rank, s0, s1)
-        x = take_rows(self.bundle.train_x, idx)
-        y = take_rows(self.bundle.train_y, idx)
         w = np.stack(
             [
                 example_weights(
@@ -773,6 +885,14 @@ class Trainer:
                 for s in range(mask.shape[0])
             ]
         )
+        if as_indices:
+            if pad_to is not None and idx.shape[1] < pad_to:
+                extra = pad_to - idx.shape[1]
+                idx = np.pad(idx, ((0, 0), (0, extra)))
+                w = np.pad(w, ((0, 0), (0, extra)))
+            return idx.astype(np.int32), w
+        x = take_rows(self.bundle.train_x, idx)
+        y = take_rows(self.bundle.train_y, idx)
         if pad_to is not None and x.shape[1] < pad_to:
             extra = pad_to - x.shape[1]
             pad1 = ((0, 0), (0, extra))
@@ -794,9 +914,13 @@ class Trainer:
         base_key = jax.random.PRNGKey(cfg.seed * 7919 + epoch)
         wkeys = jax.random.split(base_key, cfg.world_size * max(plan.num_steps, 1))
 
+        use_cache = self._use_device_cache
+
         def gather_window(s0: int, s1: int):
             return [
-                self._worker_inputs(plan, self.rank_lo + r, s0, s1)
+                self._worker_inputs(
+                    plan, self.rank_lo + r, s0, s1, as_indices=use_cache
+                )
                 for r in range(self.ws_local)
             ]
 
@@ -831,34 +955,35 @@ class Trainer:
                 for d in dev_order:
                     dev = topo.devices[d]
                     for r in groups[d]:
-                        x, y, w = data[r]
                         gr = self.rank_lo + r
                         kwin = wkeys[
                             np.arange(w0, w1) * cfg.world_size + gr
                         ]
-                        staged_win[r] = (
-                            jax.device_put(x, dev),
-                            jax.device_put(y, dev),
-                            jax.device_put(w, dev),
-                            jax.device_put(kwin, dev),
-                        )
+                        staged_win[r] = tuple(
+                            jax.device_put(a, dev) for a in data[r]
+                        ) + (jax.device_put(kwin, dev),)
                 for s_abs in range(w0, w1):
                     s = s_abs - w0
                     partials = {}
                     views = shard_views(self.state.params, self.topology.devices)
                     for d in dev_order:
                         acc = None
+                        cache = self._device_cache_for(d) if use_cache else None
                         for r in groups[d]:
-                            xw, yw, ww, kw = staged_win[r]
-                            args = (xw[s], yw[s], ww[s], kw[s], slow_dev[r])
-                            if acc is None:
-                                acc, aux = self.steps.worker_step_first(
-                                    views[d], *args
-                                )
+                            if use_cache:
+                                iw, ww, kw = staged_win[r]
+                                args = cache + (iw[s], ww[s], kw[s], slow_dev[r])
+                                step_first = self.steps.worker_step_first_idx
+                                step_acc = self.steps.worker_step_acc_idx
                             else:
-                                acc, aux = self.steps.worker_step_acc(
-                                    views[d], acc, *args
-                                )
+                                xw, yw, ww, kw = staged_win[r]
+                                args = (xw[s], yw[s], ww[s], kw[s], slow_dev[r])
+                                step_first = self.steps.worker_step_first
+                                step_acc = self.steps.worker_step_acc
+                            if acc is None:
+                                acc, aux = step_first(views[d], *args)
+                            else:
+                                acc, aux = step_acc(views[d], acc, *args)
                             aux_acc.append(aux)
                         partials[d] = acc
 
@@ -900,15 +1025,27 @@ class Trainer:
             t0 = time.perf_counter()
             d0 = topo.used_device_indices[0]
             r0 = topo.groups[d0][0]
-            x, y, w = data[r0]
             views = shard_views(self.state.params, topo.devices)
-            f = compiled_flops(
-                self.steps.worker_step_first,
-                views[d0],
-                jnp.asarray(x[0]), jnp.asarray(y[0]), jnp.asarray(w[0]),
-                base_key, jnp.int32(0),
-            )
-            self._flops_per_padded_example = f / max(x.shape[1], 1) if f else -1.0
+            if use_cache:
+                idx0, w = data[r0]
+                f = compiled_flops(
+                    self.steps.worker_step_first_idx,
+                    views[d0],
+                    *self._device_cache_for(d0),
+                    jnp.asarray(idx0[0]), jnp.asarray(w[0]),
+                    base_key, jnp.int32(0),
+                )
+                b_pad = idx0.shape[1]
+            else:
+                x, y, w = data[r0]
+                f = compiled_flops(
+                    self.steps.worker_step_first,
+                    views[d0],
+                    jnp.asarray(x[0]), jnp.asarray(y[0]), jnp.asarray(w[0]),
+                    base_key, jnp.int32(0),
+                )
+                b_pad = x.shape[1]
+            self._flops_per_padded_example = f / max(b_pad, 1) if f else -1.0
             flops_probe_overhead = time.perf_counter() - t0
 
         wloss = float(np.sum([float(a[0]) for a in aux_acc]))
@@ -940,40 +1077,47 @@ class Trainer:
         worker's host-side wall clock."""
         topo = self.topology
         cfg = self.cfg
+        use_cache = self._use_device_cache
         key = jax.random.PRNGKey(cfg.seed * 104729 + epoch)
         views = shard_views(self.state.params, topo.devices)
+        probe_step = (
+            self.steps.worker_step_first_idx
+            if use_cache
+            else self.steps.worker_step_first
+        )
         staged = {}
         for d in topo.used_device_indices:
             dev = topo.devices[d]
             for r in topo.groups[d]:
-                x, y, w = data[r]
                 gr = self.rank_lo + r
+                cache = self._device_cache_for(d) if use_cache else ()
                 staged[r] = (
-                    jax.device_put(x[0], dev),
-                    jax.device_put(y[0], dev),
-                    jax.device_put(w[0], dev),
-                    jax.device_put(key, dev),
-                    jax.device_put(jnp.int32(faults.slow_iters_per_step[gr]), dev),
+                    cache
+                    + tuple(jax.device_put(a[0], dev) for a in data[r])
+                    + (
+                        jax.device_put(key, dev),
+                        jax.device_put(
+                            jnp.int32(faults.slow_iters_per_step[gr]), dev
+                        ),
+                    ),
                     d,
                 )
         # warm pass: compile + execute everything once, untimed
-        for r, (xs, ys, ws_, k, slow, d) in staged.items():
-            _, aux = self.steps.worker_step_first(views[d], xs, ys, ws_, k, slow)
+        for r, (args, d) in staged.items():
+            _, aux = probe_step(views[d], *args)
             jax.block_until_ready(aux)
         partials = {}
         for d in topo.used_device_indices:
             acc = None
             for r in topo.groups[d]:
-                xs, ys, ws_, k, slow, _ = staged[r]
+                args, _ = staged[r]
                 gr = self.rank_lo + r
                 # probe with the non-donating first-step executable so reps
                 # are safe; each worker is measured standalone
                 dt = float("inf")
                 for _ in range(reps):
                     t0 = time.perf_counter()
-                    acc, aux = self.steps.worker_step_first(
-                        views[d], xs, ys, ws_, k, slow
-                    )
+                    acc, aux = probe_step(views[d], *args)
                     jax.block_until_ready(aux)
                     dt = min(dt, time.perf_counter() - t0)
                 w_plan = plan.workers[gr]
@@ -1001,6 +1145,20 @@ class Trainer:
                         prev = self._iter_cost_s or realized
                         self._iter_cost_s = 0.5 * prev + 0.5 * realized
             partials[d] = acc
+        if (
+            self._needs_iter_cost
+            and not self._iter_cost_calibrated
+            and float(np.max(faults.slow_iters_per_step)) == 0
+        ):
+            # Converge the in-step iteration cost on the injection-free epoch,
+            # BEFORE the first injected epoch. Without this, injection ramps
+            # up over the first few epochs as the closed loop corrects the
+            # standalone seed estimate — and an A/B benchmark would compare
+            # arms at different injection strengths (the early weak-injection
+            # epochs win every min(), systematically favoring whichever arm
+            # sampled more of them).
+            self._calibrate_iter_cost(staged, views, probe_step, plan, reps)
+            self._iter_cost_calibrated = True
         stacked = stack_partials(
             [partials[d] for d in topo.used_device_indices], self.mesh
         )
@@ -1010,6 +1168,44 @@ class Trainer:
         probed = self.steps.combine_probe(self.state, stacked)
         jax.block_until_ready(probed.params)
         return time.perf_counter() - t0
+
+    def _calibrate_iter_cost(self, staged, views, probe_step, plan, reps: int) -> None:
+        """Fixed-point iteration for the in-step synthetic-load cost: probe a
+        step with a test trip count sized to ~double the clean step time,
+        measure the realized per-iteration cost, and repeat until stable
+        (each realized measurement IS the quantity being estimated, so this
+        converges in 1-2 rounds). Runs on one worker, a handful of probe
+        steps — calibration-epoch overhead only."""
+        r0 = next(iter(staged))
+        args, d = staged[r0]
+        gr = self.rank_lo + r0
+        clean = float(self.per_example_cost[gr]) * max(
+            plan.workers[gr].batch_size, 1
+        )
+        if not np.isfinite(clean) or clean <= 0:
+            return
+        dev = self.topology.devices[d]
+        guess = self._iter_cost_s or calibrate_iter_cost()
+        for _ in range(4):
+            slow_n = max(int(round(clean / max(guess, 1e-12))), 1)
+            test_args = args[:-1] + (jax.device_put(jnp.int32(slow_n), dev),)
+            dt = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                _, aux = probe_step(views[d], *test_args)
+                jax.block_until_ready(aux)
+                dt = min(dt, time.perf_counter() - t0)
+            realized = (dt - clean) / slow_n
+            if realized <= 0 or not np.isfinite(realized):
+                break
+            done = abs(realized - guess) <= 0.05 * guess
+            guess = realized
+            if done:
+                break
+        self._iter_cost_s = guess
+        self.logger.info(
+            f"injection calibrated: {guess * 1e6:.2f}us/iter (in-step)"
+        )
 
     # ------------------------------------------------------------- validate
 

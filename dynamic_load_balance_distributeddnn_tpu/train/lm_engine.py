@@ -150,11 +150,16 @@ class LMTrainer(Trainer):
         )
 
     def _worker_inputs(
-        self, plan: EpochPlan, rank: int, s0: int = 0, s1=None, *, pad_to=None
+        self, plan: EpochPlan, rank: int, s0: int = 0, s1=None, *, pad_to=None,
+        as_indices: bool = False
     ):
         # pad_to: the fused-DBS capacity layout — every worker presents
         # ``cap`` columns (padding masked to zero weight) so one compiled
         # scan serves every rebalanced plan, exactly as in the vision path.
+        # as_indices: the vision device-cache mode — never active here (the
+        # LM has no cacheable train arrays; _decide_device_cache returns
+        # False), accepted for signature parity.
+        assert not as_indices
         #
         # The epoch's windows are plan-deterministic, so they are built ONCE
         # per (epoch, rank, pad) and the chunked fused gather / probe calls

@@ -198,6 +198,34 @@ class StepLibrary:
         self.worker_step_first = worker_step_first
         self.worker_step_acc = worker_step_acc
 
+        # Index-fed twins for the device-resident data cache: the train
+        # arrays live in HBM; each step gathers its rows on device, so the
+        # host sends [b_pad] int32 indices instead of the batch itself.
+        # Padding slots index row 0 and carry weight 0 — identical math to
+        # the materialized path (same rows, same weights).
+        @jax.jit
+        def worker_step_first_idx(params, train_x, train_y, idx, w, rng, slow_iters):
+            x = jnp.take(train_x, idx, axis=0, mode="clip")
+            y = jnp.take(train_y, idx, axis=0, mode="clip")
+            g, wloss, loss_sum, count, probe = local_grads(
+                params, x, y, w, rng, slow_iters, rng
+            )
+            acc = jax.tree_util.tree_map(lambda t: t[None], g)
+            return acc, (wloss, loss_sum, count, probe)
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def worker_step_acc_idx(params, acc, train_x, train_y, idx, w, rng, slow_iters):
+            x = jnp.take(train_x, idx, axis=0, mode="clip")
+            y = jnp.take(train_y, idx, axis=0, mode="clip")
+            g, wloss, loss_sum, count, probe = local_grads(
+                params, x, y, w, rng, slow_iters, rng
+            )
+            acc = jax.tree_util.tree_map(lambda a, t: a + t[None], acc, g)
+            return acc, (wloss, loss_sum, count, probe)
+
+        self.worker_step_first_idx = worker_step_first_idx
+        self.worker_step_acc_idx = worker_step_acc_idx
+
         # -------------------------------------------------- combine + update
 
         replicated = NamedSharding(self.mesh, P())
@@ -444,6 +472,41 @@ class StepLibrary:
             in_specs=(
                 self._state_spec(),
                 P(None, DATA_AXIS),
+                P(None, DATA_AXIS),
+                P(None, DATA_AXIS),
+                P(DATA_AXIS),
+                P(),
+            ),
+            out_specs=(self._state_spec(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    @functools.cached_property
+    def fused_epoch_idx(self):
+        """``fused_epoch`` fed by the device-resident data cache: the train
+        arrays are passed replicated (already on device — no re-transfer) and
+        each scanned step gathers its rows by index on device. The per-epoch
+        host->device traffic is [steps, D*b] int32 + f32 weights instead of
+        the batches themselves — the whole-dataset epoch transfer disappears."""
+
+        def per_shard(state, train_x, train_y, idxs, ws_, slow_iters, seed):
+            def body(state, inp):
+                idx_s, w = inp
+                x = jnp.take(train_x, idx_s, axis=0, mode="clip")
+                y = jnp.take(train_y, idx_s, axis=0, mode="clip")
+                return self._fused_shard_body(state, x, y, w, slow_iters[0], seed)
+
+            state, metrics = jax.lax.scan(body, state, (idxs, ws_))
+            return state, jnp.sum(metrics, axis=0)
+
+        sharded = jax.shard_map(
+            per_shard,
+            mesh=self.mesh,
+            in_specs=(
+                self._state_spec(),
+                P(),
+                P(),
                 P(None, DATA_AXIS),
                 P(None, DATA_AXIS),
                 P(DATA_AXIS),

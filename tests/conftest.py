@@ -28,3 +28,22 @@ def devices():
     import jax
 
     return jax.devices()
+
+
+def make_tiny_corpus(dirpath, vocab=50, lines=400, words_per_line=12, seed=0):
+    """Shared synthetic random-word corpus on disk (train/valid/test .txt),
+    returned as a loaded Corpus — the LM tests' common fixture material."""
+    import numpy as np
+
+    from dynamic_load_balance_distributeddnn_tpu.data.corpus import Corpus
+
+    rng = np.random.RandomState(seed)
+    words = [f"tok{i}" for i in range(vocab)]
+    text = "\n".join(
+        " ".join(rng.choice(words, size=words_per_line)) for _ in range(lines)
+    )
+    dirpath.mkdir(parents=True, exist_ok=True)
+    (dirpath / "train.txt").write_text(text)
+    (dirpath / "valid.txt").write_text(text[:2000])
+    (dirpath / "test.txt").write_text(text[:2000])
+    return Corpus(str(dirpath))

@@ -1,0 +1,104 @@
+"""Device-resident data cache: feeding epochs by index (on-device gather
+from HBM-resident train arrays) must be bitwise-identical to materializing
+batches on the host — same rows, same weights, same rng stream. The cache
+only changes WHERE the gather happens (device instead of host) and what
+crosses the wire per epoch ([steps, batch] int32 instead of the dataset).
+"""
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
+from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+import jax
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return synthetic_dataset("mnist", n_train=1024, n_test=256)
+
+
+def _params(tr):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(tr.state.params)]
+
+
+def _run(bundle, cache, dbs, epochs=2, **kw):
+    cfg = Config(
+        debug=True,
+        world_size=4,
+        batch_size=128,
+        learning_rate=0.05,
+        epoch_size=epochs,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=dbs,
+        seed=1234,
+        bucket=8,
+        device_cache=cache,
+        **kw,
+    )
+    def linear_time(plan):
+        return np.array([2.0, 1.0, 1.0, 1.0]) * np.array(
+            [w.batch_size * w.steps for w in plan.workers]
+        )
+
+    tr = Trainer(
+        cfg,
+        bundle=bundle,
+        injector=StaticStragglerInjector([2.0, 1.0, 1.0, 1.0], mode="virtual")
+        if dbs
+        else None,
+        timing_model=linear_time if dbs else None,
+        log_to_file=False,
+    )
+    rec = tr.run()
+    return tr, rec
+
+
+def test_cache_auto_enables_on_small_vision_bundle(bundle):
+    tr, _ = _run(bundle, cache="auto", dbs=False, epochs=1)
+    assert tr._use_device_cache
+
+
+def test_fused_path_cache_bitwise_equal(bundle):
+    tr_off, rec_off = _run(bundle, cache="off", dbs=False)
+    tr_on, rec_on = _run(bundle, cache="on", dbs=False)
+    assert not tr_off._use_device_cache and tr_on._use_device_cache
+    np.testing.assert_array_equal(rec_off.data["train_loss"], rec_on.data["train_loss"])
+    for a, b in zip(_params(tr_off), _params(tr_on)):
+        np.testing.assert_array_equal(a, b)
+    # the cache path ran the idx scan, not the materialized one
+    assert tr_on.steps.__dict__.get("fused_epoch_idx") is not None
+    assert "fused_epoch" not in tr_on.steps.__dict__ or (
+        tr_on.steps.fused_epoch._cache_size() == 0
+    )
+
+
+def test_elastic_dbs_cache_bitwise_equal(bundle):
+    tr_off, rec_off = _run(bundle, cache="off", dbs=True)
+    tr_on, rec_on = _run(bundle, cache="on", dbs=True)
+    np.testing.assert_array_equal(rec_off.data["train_loss"], rec_on.data["train_loss"])
+    np.testing.assert_allclose(
+        rec_off.data["partition"], rec_on.data["partition"], atol=1e-12
+    )
+    for a, b in zip(_params(tr_off), _params(tr_on)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_lm_never_caches(tmp_path):
+    from dynamic_load_balance_distributeddnn_tpu.train.lm_engine import LMTrainer
+    from tests.conftest import make_tiny_corpus
+
+    corpus = make_tiny_corpus(tmp_path / "c", vocab=30, lines=200, words_per_line=10)
+    cfg = Config(
+        debug=True, world_size=4, batch_size=40, epoch_size=1,
+        dataset="wikitext2", model="transformer", dynamic_batch_size=False,
+        bucket=4, bptt=8, device_cache="on",
+    )
+    tr = LMTrainer(cfg, bundle=corpus, log_to_file=False)
+    assert not tr._use_device_cache
+    rec = tr.run()
+    assert np.isfinite(rec.data["train_loss"]).all()

@@ -62,12 +62,17 @@ def test_fused_dbs_matches_elastic_partitions(bundle):
         losses = rec.data["train_loss"]
         assert np.isfinite(losses).all() and losses[-1] < losses[0] * 1.2
     # the fused scan actually ran (compiled) and the elastic steps did NOT
-    assert "fused_epoch" in tr_f.steps.__dict__
-    assert tr_f.steps.fused_epoch._cache_size() >= 1
+    # (the device cache routes through the _idx variant of the scan)
+    scan = (
+        tr_f.steps.fused_epoch_idx
+        if tr_f._use_device_cache
+        else tr_f.steps.fused_epoch
+    )
+    assert scan._cache_size() >= 1
     assert tr_f.steps.worker_step_acc._cache_size() == 0
     # capacity layout: one scan geometry for ALL plans (uniform epoch 0 and
     # every rebalanced epoch share the compiled shapes; body+tail windows)
-    assert tr_f.steps.fused_epoch._cache_size() <= 2
+    assert scan._cache_size() <= 2
 
 
 @pytest.mark.slow
@@ -112,16 +117,9 @@ def test_fused_dbs_with_compressed_collective(bundle):
 
 @pytest.fixture(scope="module")
 def corpus(tmp_path_factory):
-    from dynamic_load_balance_distributeddnn_tpu.data.corpus import Corpus
+    from tests.conftest import make_tiny_corpus
 
-    d = tmp_path_factory.mktemp("corpus")
-    rng = np.random.RandomState(0)
-    words = [f"tok{i}" for i in range(50)]
-    text = "\n".join(" ".join(rng.choice(words, size=12)) for _ in range(400))
-    (d / "train.txt").write_text(text)
-    (d / "valid.txt").write_text(text[:2000])
-    (d / "test.txt").write_text(text[:2000])
-    return Corpus(str(d))
+    return make_tiny_corpus(tmp_path_factory.mktemp("corpus"))
 
 
 @pytest.mark.slow

@@ -4,7 +4,6 @@ import numpy as np
 import pytest
 
 from dynamic_load_balance_distributeddnn_tpu.config import Config
-from dynamic_load_balance_distributeddnn_tpu.data.corpus import Corpus
 from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
 from dynamic_load_balance_distributeddnn_tpu.train.lm_engine import LMTrainer
 
@@ -13,16 +12,9 @@ pytestmark = pytest.mark.slow  # multi-epoch LM e2e with 200-dim transformer
 
 @pytest.fixture(scope="module")
 def tiny_corpus(tmp_path_factory):
-    d = tmp_path_factory.mktemp("corpus")
-    rng = np.random.RandomState(0)
-    words = [f"tok{i}" for i in range(50)]
-    text = "\n".join(
-        " ".join(rng.choice(words, size=12)) for _ in range(400)
-    )
-    (d / "train.txt").write_text(text)
-    (d / "valid.txt").write_text(text[:2000])
-    (d / "test.txt").write_text(text[:2000])
-    return Corpus(str(d))
+    from tests.conftest import make_tiny_corpus
+
+    return make_tiny_corpus(tmp_path_factory.mktemp("corpus"))
 
 
 def lm_cfg(tmp_path, **kw):

@@ -218,25 +218,26 @@ class Trainer:
             if cfg.device_cache == "on":
                 self.logger.warning("device_cache=on ignored: no cacheable train arrays")
             return False
-        if self.n_proc > 1:
-            # multi-host replication of the cache is future work; the
-            # materialized path remains correct there
-            if cfg.device_cache == "on":
-                self.logger.warning("device_cache=on ignored: multi-process run")
-            return False
         if cfg.device_cache == "on":
             return True
         return tx.nbytes + ty.nbytes <= cfg.device_cache_mb * 1_000_000
 
     def _device_cache_replicated(self):
         if self._cache_repl is None:
-            self._cache_repl = (
-                jax.device_put(self.bundle.train_x, replicated_sharding(self.mesh)),
-                jax.device_put(
-                    np.asarray(self.bundle.train_y, dtype=np.int32),
-                    replicated_sharding(self.mesh),
-                ),
+            arrays = (
+                self.bundle.train_x,
+                np.asarray(self.bundle.train_y, dtype=np.int32),
             )
+            sh = replicated_sharding(self.mesh)
+            if self.n_proc == 1:
+                self._cache_repl = tuple(jax.device_put(a, sh) for a in arrays)
+            else:
+                # every process holds the identical bundle (same files/seed),
+                # so its full array IS the addressable portion of the
+                # replicated global array
+                self._cache_repl = tuple(
+                    jax.make_array_from_process_local_data(sh, a) for a in arrays
+                )
         return self._cache_repl
 
     def _device_cache_for(self, d: int):
@@ -1246,7 +1247,10 @@ class Trainer:
         if cache_ok and cached is not None and cached[0] == key:
             staged = cached[1]
         elif cached is not None:
-            self._eval_chunk_cache = None  # release before any restaging
+            # release before any restaging (drop BOTH references — the local
+            # would otherwise pin the old chunk set in HBM through the loop)
+            self._eval_chunk_cache = None
+            cached = None
 
         loss_sum = correct = count = 0.0
 

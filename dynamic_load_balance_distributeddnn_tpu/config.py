@@ -176,9 +176,25 @@ class Config:
                                        # epoch host->device traffic drops
                                        # from the whole dataset to [steps,
                                        # batch] int32. auto = on when the
-                                       # arrays fit device_cache_mb, vision
-                                       # path, single process.
+                                       # arrays fit device_cache_mb (vision
+                                       # path; multi-host replicates the
+                                       # cache on every process's devices).
     device_cache_mb: int = 512         # HBM budget for the device cache
+    packed: str = "auto"               # "auto"|"on"|"off": single-device
+                                       # packed epochs — when every worker
+                                       # lives on ONE chip (the contention
+                                       # topology, e.g. the reference's
+                                       # -gpu 0,0,0,0), concatenate the
+                                       # workers' true-width batches into one
+                                       # compiled whole-epoch scan (psum on a
+                                       # 1-chip mesh is identity, so the
+                                       # weighted-sum combine is unchanged).
+                                       # True per-worker batch sizes — only
+                                       # <= ws*bucket rows of padding, vs the
+                                       # capacity layout's 2x — and zero
+                                       # per-step Python dispatch. Balancer
+                                       # signal still comes from the
+                                       # standalone per-worker probes.
 
     def __post_init__(self):
         if self.model not in MODELS:
@@ -197,6 +213,8 @@ class Config:
             raise ValueError("compress_grads must be '' or 'int8'")
         if self.device_cache not in ("auto", "on", "off"):
             raise ValueError("device_cache must be 'auto', 'on' or 'off'")
+        if self.packed not in ("auto", "on", "off"):
+            raise ValueError("packed must be 'auto', 'on' or 'off'")
         if self.compress_grads and self.dynamic_batch_size and not self.fused_dbs:
             raise ValueError(
                 "compress_grads rides a fused path (the elastic DBS combine "
@@ -336,6 +354,11 @@ def get_parser() -> argparse.ArgumentParser:
                         "index (on-device gather): per-epoch reshard costs an "
                         "index upload instead of re-transferring the dataset.")
     p.add_argument("--device_cache_mb", type=int, default=d.device_cache_mb)
+    p.add_argument("--packed", type=str, default=d.packed,
+                   choices=["auto", "on", "off"],
+                   help="Single-device packed epochs: concat all workers' "
+                        "true-width batches into one compiled whole-epoch "
+                        "scan when every worker shares one chip.")
     return p
 
 

@@ -200,6 +200,11 @@ class Trainer:
         self._use_device_cache = self._decide_device_cache()
         self._cache_repl = None
         self._cache_dev: Dict[int, tuple] = {}
+        if cfg.packed == "on":
+            # fail fast at init: the epoch dispatch prefers the fused paths,
+            # so a forced-but-infeasible packed config would otherwise be
+            # silently overridden (or only rejected mid-run)
+            self._can_use_packed(None)
         if self._use_device_cache:
             mb = (self.bundle.train_x.nbytes + self.bundle.train_y.nbytes) / 1e6
             self.logger.info(
@@ -522,6 +527,20 @@ class Trainer:
             train_metrics = self._train_epoch_fused(plan, faults, epoch)
         elif self._can_use_fused_dbs(plan):
             train_metrics = self._train_epoch_fused(plan, faults, epoch, dbs_probe=True)
+        elif self._can_use_packed(plan):
+            # probes still needed for the balancer signal and/or compute-mode
+            # injection calibration — mirrors the elastic path's condition
+            train_metrics = self._train_epoch_fused(
+                plan,
+                faults,
+                epoch,
+                dbs_probe=(
+                    cfg.dynamic_batch_size
+                    or self._needs_iter_cost
+                    or self.timing_model is not None
+                ),
+                packed=True,
+            )
         else:
             train_metrics = self._train_epoch_elastic(plan, faults, epoch)
         epoch_wall = (
@@ -634,6 +653,44 @@ class Trainer:
         max_share = min(1.0, cfg.capacity_factor / cfg.world_size)
         return -(-int(np.ceil(max_share * cfg.batch_size)) // cfg.bucket) * cfg.bucket
 
+    @property
+    def _cap_packed(self) -> int:
+        """Packed-epoch concat width: every plan's per-worker bucketed widths
+        sum to at most B + ws*bucket (the integer split sums to exactly B;
+        each worker adds < bucket of padding), so ONE fixed width serves
+        every rebalanced plan with <= ws*bucket zero-weight rows."""
+        cfg = self.cfg
+        return cfg.batch_size + cfg.world_size * cfg.bucket
+
+    def _can_use_packed(self, plan) -> bool:
+        """Single-device packed epochs: all workers share ONE chip (the
+        reference's contention topology, -gpu 0,0,0,0), so the weighted-sum
+        gradient combine over the concatenated true-width batches is the
+        elastic path's exact math (psum over a 1-chip mesh is identity) in
+        one compiled whole-epoch scan instead of ws+1 dispatches per step.
+        The balancer's per-worker time signal still comes from the
+        standalone probes. Needs the device cache (index feed), no
+        per-worker grad clip (the LM's clip is per worker, not global), and
+        none of the fused-only features."""
+        cfg = self.cfg
+        if cfg.packed == "off":
+            return False
+        ok = (
+            self.n_dev == 1
+            and self.n_proc == 1
+            and self._use_device_cache
+            and cfg.grad_clip == 0
+            and not cfg.shard_update
+            and not cfg.compress_grads
+            and cfg.grad_accum <= 1
+        )
+        if cfg.packed == "on" and not ok:
+            raise ValueError(
+                "packed=on needs a single-device topology, the device cache, "
+                "and no grad_clip/shard_update/compress_grads/grad_accum"
+            )
+        return ok
+
     def _chunk_ranges(self, num_steps: int):
         """Step windows of the streaming host path: ``stream_chunk_steps``-sized
         windows (0 = one whole-epoch window). At most two distinct window
@@ -645,12 +702,14 @@ class Trainer:
         return [(s, min(s + chunk, num_steps)) for s in range(0, num_steps, chunk)]
 
     def _gather_fused_window(self, plan, s0: int, s1: int, pad_to=None,
-                             as_indices: bool = False):
+                             as_indices: bool = False, pack_total=None):
         """Host-side gather of steps [s0, s1): [n, ws*b_pad, ...] numpy arrays
         in the fused path's global layout (worker r owns slice r; each process
         materializes only its own workers' slice). ``pad_to``: fused-DBS
         capacity width per worker. ``as_indices``: device-cache mode — the
-        window is (idx, w) only; rows gather on device."""
+        window is (idx, w) only; rows gather on device. ``pack_total``:
+        packed-epoch mode — workers keep their true bucketed widths and the
+        CONCAT pads (zero weight) to this fixed global width."""
         data = [
             self._worker_inputs(
                 plan, self.rank_lo + r, s0, s1, pad_to=pad_to,
@@ -658,10 +717,17 @@ class Trainer:
             )
             for r in range(self.ws_local)
         ]
-        return tuple(
+        out = tuple(
             np.concatenate([d[i] for d in data], axis=1)
             for i in range(len(data[0]))
         )
+        if pack_total is not None and out[0].shape[1] < pack_total:
+            extra = pack_total - out[0].shape[1]
+            out = tuple(
+                np.pad(a, ((0, 0), (0, extra)) + ((0, 0),) * (a.ndim - 2))
+                for a in out
+            )
+        return out
 
     def _put_fused_window(self, *arrays):
         from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
@@ -680,19 +746,35 @@ class Trainer:
         )
 
     def _train_epoch_fused(
-        self, plan, faults: EpochFaults, epoch: int, dbs_probe: bool = False
+        self, plan, faults: EpochFaults, epoch: int, dbs_probe: bool = False,
+        packed: bool = False,
     ) -> Dict[str, float]:
         """``dbs_probe=True``: the fused-DBS mode — every worker padded to the
         fixed capacity width (one compiled scan for every plan), with the
         balancer's per-worker time signal measured by the standalone probe
-        step after the epoch (untimed, like the elastic path's probes)."""
+        step after the epoch (untimed, like the elastic path's probes).
+
+        ``packed=True``: the single-device packed mode — workers keep their
+        TRUE bucketed widths, concatenated (then padded to the fixed
+        ``_cap_packed`` width) into the same scan; the 1-chip psum is an
+        identity, so this is the elastic combine's math with zero per-step
+        dispatch. Injected synthetic load is the per-worker total (the chip
+        serializes the workers either way)."""
         cfg = self.cfg
         self.timekeeper.reset()
-        pad_to = self._cap_b if dbs_probe else None
+        pad_to = self._cap_b if (dbs_probe and not packed) else None
+        pack_total = self._cap_packed if packed else None
         from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
 
         mesh = self.mesh
-        if self.n_proc == 1:
+        if packed:
+            slow = jax.device_put(
+                np.array(
+                    [faults.slow_iters_per_step.sum()], dtype=np.int32
+                ),
+                batch_sharding(mesh, 1),
+            )
+        elif self.n_proc == 1:
             slow = jax.device_put(
                 faults.slow_iters_per_step.astype(np.int32),
                 batch_sharding(mesh, 1),
@@ -719,14 +801,15 @@ class Trainer:
             cache_x, cache_y = self._device_cache_replicated()
         with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
             fut = pool.submit(
-                self._gather_fused_window, plan, *ranges[0], pad_to, use_cache
+                self._gather_fused_window, plan, *ranges[0], pad_to, use_cache,
+                pack_total,
             )
             for i, _ in enumerate(ranges):
                 win = self._put_fused_window(*fut.result())
                 if i + 1 < len(ranges):
                     fut = pool.submit(
                         self._gather_fused_window, plan, *ranges[i + 1], pad_to,
-                        use_cache,
+                        use_cache, pack_total,
                     )
                 if use_cache:
                     idxs, ws_ = win
@@ -751,7 +834,9 @@ class Trainer:
                 # device-cache mode: materialize ONE step's batches for the
                 # one-time sync/FLOPs probes (probe-overhead time, not wall)
                 first_window = self._put_fused_window(
-                    *self._gather_fused_window(plan, 0, 1, pad_to)
+                    *self._gather_fused_window(
+                        plan, 0, 1, pad_to, pack_total=pack_total
+                    )
                 )
             xs, ys, ws_ = first_window
             self._fused_sync_per_step = self._probe_fused_sync(
@@ -810,13 +895,15 @@ class Trainer:
             "wloss": wloss / max(plan.num_steps, 1),
             "sync_time": self._fused_sync_per_step * plan.num_steps,
             "probe_overhead": probe_overhead,
-            # executed padded examples (capacity layout runs cap_b per worker
-            # regardless of the plan's true batches) — MFU accounting
-            "padded_examples": float(
-                cfg.world_size * self._cap_b * plan.num_steps
-            )
-            if dbs_probe
-            else None,
+            # executed padded examples (capacity layout runs cap_b per worker,
+            # packed runs cap_packed total, regardless of true batches) — MFU
+            "padded_examples": (
+                float(self._cap_packed * plan.num_steps)
+                if packed
+                else float(cfg.world_size * self._cap_b * plan.num_steps)
+                if dbs_probe
+                else None
+            ),
         }
 
     def _probe_fused_sync(self, xs, ys, ws_, slow, seed, reps: int = 3) -> float:

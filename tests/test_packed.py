@@ -1,0 +1,127 @@
+"""Single-device packed epochs: when every worker shares one chip
+(device=0, the reference's contention map -gpu 0,0,0,0), the workers'
+true-width batches concatenate into one compiled whole-epoch scan. The
+weighted-sum combine is the elastic path's exact math (psum over a 1-chip
+mesh is identity), so the balancer trajectory — driven by the same
+deterministic timing model — must match the elastic path's exactly, while
+per-step Python dispatch disappears."""
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+from dynamic_load_balance_distributeddnn_tpu.faults import StaticStragglerInjector
+from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return synthetic_dataset("mnist", n_train=1024, n_test=256)
+
+
+def linear_time(plan):
+    return np.array([3.0, 1.0, 1.0, 1.0]) * np.array(
+        [w.batch_size * w.steps for w in plan.workers]
+    )
+
+
+def _run(bundle, packed, dbs=True, **kw):
+    cfg = Config(
+        debug=True,
+        world_size=4,
+        batch_size=128,
+        learning_rate=0.05,
+        epoch_size=4,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=dbs,
+        fault_tolerance=True,
+        seed=1234,
+        bucket=8,
+        device=0,  # all workers on one chip — the contention topology
+        packed=packed,
+        **kw,
+    )
+    tr = Trainer(
+        cfg,
+        bundle=bundle,
+        injector=StaticStragglerInjector([3.0, 1.0, 1.0, 1.0], mode="virtual"),
+        timing_model=linear_time,
+        log_to_file=False,
+    )
+    rec = tr.run()
+    return tr, rec
+
+
+def test_packed_engages_and_matches_elastic_partitions(bundle):
+    tr_e, rec_e = _run(bundle, packed="off")
+    tr_p, rec_p = _run(bundle, packed="auto")
+    # identical timing model + deterministic solver -> identical partitions
+    np.testing.assert_allclose(
+        rec_e.data["partition"], rec_p.data["partition"], atol=1e-9
+    )
+    for rec in (rec_e, rec_p):
+        losses = rec.data["train_loss"]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0] * 1.2
+    # the packed scan compiled; the elastic hot loop never dispatched
+    # (probes use the _idx single-step executable, which is separate)
+    assert tr_p.steps.fused_epoch_idx._cache_size() >= 1
+    assert tr_p.steps.worker_step_acc._cache_size() == 0
+    assert tr_p.steps.worker_step_acc_idx._cache_size() == 0
+    # one fixed concat width -> at most body+tail scan geometries
+    assert tr_p.steps.fused_epoch_idx._cache_size() <= 2
+    # elastic run on the same topology did use the elastic loop
+    assert tr_e.steps.worker_step_first_idx._cache_size() >= 1
+
+
+def test_packed_dbs_off_single_device(bundle):
+    """dbs-off single-chip runs also take the packed scan (uniform plan)."""
+    tr, rec = _run(bundle, packed="auto", dbs=False)
+    assert np.isfinite(rec.data["train_loss"]).all()
+    assert tr.steps.fused_epoch_idx._cache_size() >= 1
+
+
+def test_packed_on_requires_topology(bundle):
+    cfg = Config(
+        debug=True, world_size=4, batch_size=128, epoch_size=1,
+        dataset="mnist", model="mnistnet", dynamic_batch_size=False,
+        packed="on",  # round-robin device map -> 4 devices -> not packable
+    )
+    # fail-fast at init: the fused paths would otherwise silently override
+    # the forced packed config
+    with pytest.raises(ValueError, match="packed=on"):
+        Trainer(cfg, bundle=bundle, log_to_file=False)
+
+
+@pytest.mark.slow
+def test_packed_measured_signal_converges(bundle):
+    """No timing model: real probe walls + compute-mode injection drive the
+    partition on the packed path."""
+    cfg = Config(
+        debug=True,
+        world_size=4,
+        batch_size=128,
+        learning_rate=0.05,
+        epoch_size=5,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=True,
+        fault_tolerance=True,
+        fault_mode="compute",
+        seed=77,
+        bucket=8,
+        device=0,
+        packed="auto",
+        time_smoothing=0.3,
+    )
+    tr = Trainer(
+        cfg,
+        bundle=bundle,
+        injector=StaticStragglerInjector([3.0, 1.0, 1.0, 1.0], mode="compute"),
+        log_to_file=False,
+    )
+    rec = tr.run()
+    final = np.array(rec.data["partition"][-1])
+    assert final[0] < 0.25 - 0.04, f"straggler share did not drop: {rec.data['partition']}"
+    assert final.sum() == pytest.approx(1.0)

@@ -669,16 +669,19 @@ class Trainer:
         elastic path's exact math (psum over a 1-chip mesh is identity) in
         one compiled whole-epoch scan instead of ws+1 dispatches per step.
         The balancer's per-worker time signal still comes from the
-        standalone probes. Needs the device cache (index feed), no
-        per-worker grad clip (the LM's clip is per worker, not global), and
-        none of the fused-only features."""
+        standalone probes. Works with or without the device cache (index
+        feed vs materialized windows). Needs no per-worker grad clip (the
+        LM's clip is per worker, not global) and none of the fused-only
+        features; vision only (the LM's column batches stay elastic or use
+        fused_dbs)."""
         cfg = self.cfg
         if cfg.packed == "off":
             return False
         ok = (
             self.n_dev == 1
             and self.n_proc == 1
-            and self._use_device_cache
+            and self.bundle is not None
+            and getattr(self.bundle, "train_x", None) is not None
             and cfg.grad_clip == 0
             and not cfg.shard_update
             and not cfg.compress_grads
@@ -686,8 +689,8 @@ class Trainer:
         )
         if cfg.packed == "on" and not ok:
             raise ValueError(
-                "packed=on needs a single-device topology, the device cache, "
-                "and no grad_clip/shard_update/compress_grads/grad_accum"
+                "packed=on needs a single-device vision topology and no "
+                "grad_clip/shard_update/compress_grads/grad_accum"
             )
         return ok
 
@@ -717,17 +720,20 @@ class Trainer:
             )
             for r in range(self.ws_local)
         ]
-        out = tuple(
-            np.concatenate([d[i] for d in data], axis=1)
-            for i in range(len(data[0]))
-        )
-        if pack_total is not None and out[0].shape[1] < pack_total:
-            extra = pack_total - out[0].shape[1]
-            out = tuple(
-                np.pad(a, ((0, 0), (0, extra)) + ((0, 0),) * (a.ndim - 2))
-                for a in out
-            )
-        return out
+        width = sum(d[0].shape[1] for d in data)
+        extra = (pack_total - width) if pack_total is not None else 0
+        out = []
+        for i in range(len(data[0])):
+            parts = [d[i] for d in data]
+            if extra > 0:
+                # zero pad block folded into the single concat pass (a
+                # post-hoc np.pad would copy the whole window a second time)
+                a0 = parts[0]
+                parts.append(
+                    np.zeros((a0.shape[0], extra) + a0.shape[2:], a0.dtype)
+                )
+            out.append(np.concatenate(parts, axis=1))
+        return tuple(out)
 
     def _put_fused_window(self, *arrays):
         from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding

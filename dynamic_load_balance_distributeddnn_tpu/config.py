@@ -89,6 +89,27 @@ class Config:
     time_smoothing: float = 0.0        # EMA factor on the measured node-time
                                        # vector (0 = off, exact reference
                                        # semantics: raw last-epoch times)
+    probe_mode: str = "adaptive"       # "always": per-worker probe steps every
+                                       # epoch (round-2 behavior; the reference
+                                       # analogue, since it re-times every
+                                       # epoch, dbs.py:226-250). "adaptive":
+                                       # probe epochs 0-1 to anchor a linear
+                                       # per-example cost model, then SKIP
+                                       # probes and feed the solver modeled
+                                       # times, re-probing every probe_every
+                                       # epochs, when the injection episode
+                                       # changes, or when a skipped epoch's
+                                       # wall deviates probe_wall_tol from the
+                                       # last probed wall — so the balancer's
+                                       # signal costs ~nothing once converged
+                                       # (the reference's signal is free too:
+                                       # it times the epoch it already ran)
+    probe_every: int = 5               # adaptive mode: max epochs between real
+                                       # probe anchors
+    probe_wall_tol: float = 0.25       # adaptive mode: relative epoch-wall
+                                       # deviation (vs the last probed epoch,
+                                       # probe cost excluded) that forces a
+                                       # re-probe next epoch
     fault_mode: str = "virtual"        # "virtual": add simulated seconds to the
                                        # measured time vector (exact reference
                                        # semantics, dbs.py:94-129);
@@ -207,6 +228,8 @@ class Config:
             raise ValueError("device map length must equal world_size")
         if self.fault_mode not in ("virtual", "compute"):
             raise ValueError("fault_mode must be 'virtual' or 'compute'")
+        if self.probe_mode not in ("adaptive", "always"):
+            raise ValueError("probe_mode must be 'adaptive' or 'always'")
         if self.straggler and len(self.straggler_factors()) != self.world_size:
             raise ValueError("straggler factor list length must equal world_size")
         if self.compress_grads not in ("", "int8"):
@@ -326,6 +349,13 @@ def get_parser() -> argparse.ArgumentParser:
                    help="Stream the host data path in windows of N steps "
                         "(prefetch overlaps compute); 0 = materialize whole epochs.")
     p.add_argument("--time_smoothing", type=float, default=d.time_smoothing)
+    p.add_argument("--probe_mode", type=str, default=d.probe_mode,
+                   choices=["adaptive", "always"],
+                   help="adaptive: skip per-worker probe steps once the "
+                        "cost model is anchored (re-probe on schedule/episode "
+                        "change/wall deviation); always: probe every epoch.")
+    p.add_argument("--probe_every", type=int, default=d.probe_every)
+    p.add_argument("--probe_wall_tol", type=float, default=d.probe_wall_tol)
     p.add_argument("--fault_mode", type=str, default=d.fault_mode, choices=["virtual", "compute"])
     p.add_argument("--straggler", type=str, default=d.straggler,
                    help="Deterministic per-worker slowdown factors, e.g. '3,1,1,1' "

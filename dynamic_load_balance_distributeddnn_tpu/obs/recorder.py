@@ -47,9 +47,26 @@ def _pythonize(v):
     return v
 
 
+def _pythonize_meta(meta: Dict) -> Dict:
+    return {k: _pythonize(v) for k, v in meta.items()}
+
+
 class MetricsRecorder:
     def __init__(self):
         self.data: Dict[str, List] = {k: [] for k in SERIES}
+        # Run-level facts that are not per-epoch series (e.g. whether the
+        # data was a synthetic stand-in); saved under "_meta" in the JSON
+        # sidecar, kept out of the reference-parity .npy payload.
+        self.meta: Dict[str, object] = {}
+
+    def stamp_data_source(self, src) -> None:
+        """Record data provenance (synthetic stand-in? which fallbacks?) from
+        a DatasetBundle or Corpus — every trainer stamps its recorder so the
+        saved artifacts can't be mistaken for real-data results."""
+        self.meta["synthetic"] = bool(getattr(src, "synthetic", False))
+        notes = list(getattr(src, "notes", []))
+        if notes:
+            self.meta["data_notes"] = notes
 
     def record_epoch(self, **kw) -> None:
         """The reference's nine series are mandatory; extra keyword series
@@ -66,8 +83,11 @@ class MetricsRecorder:
         stem = base_filename.format(rank)
         npy_path = os.path.join(stat_dir, stem + ".npy")
         np.save(npy_path, self.data)  # dict payload, like the reference
+        payload = dict(self.data)
+        if self.meta:
+            payload["_meta"] = _pythonize_meta(self.meta)
         with open(os.path.join(stat_dir, stem + ".json"), "w") as f:
-            json.dump(self.data, f)
+            json.dump(payload, f)
         return npy_path
 
     def last(self, key: str):

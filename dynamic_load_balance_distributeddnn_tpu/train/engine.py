@@ -172,6 +172,9 @@ class Trainer:
         )
 
         self.recorder = MetricsRecorder()
+        self.recorder.stamp_data_source(
+            self.bundle if self.bundle is not None else getattr(self, "corpus", None)
+        )
         self.shares = initial_partition(cfg.world_size)
         self.node_times = np.ones(cfg.world_size, dtype=np.float64)
         self.per_example_cost = np.full(cfg.world_size, np.nan)

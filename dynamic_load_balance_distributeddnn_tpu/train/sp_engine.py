@@ -119,6 +119,7 @@ class SeqParallelLMTrainer:
 
         self._update = update
         self.recorder = MetricsRecorder()
+        self.recorder.stamp_data_source(self.corpus)
         self.total_wallclock = 0.0
 
     # ------------------------------------------------------------------ loop

@@ -20,6 +20,17 @@ import jax  # noqa: E402
 # wins over the plugin. Must run before any backend is touched.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: the tier's wall is compile-dominated (every
+# Trainer builds fresh jit closures), and identical programs recur across
+# tests and across runs. Cold runs pay full price once; warm reruns of the
+# fast tier drop several-fold.
+_cache_dir = os.environ.get(
+    "TEST_JAX_CACHE", os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+)
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import pytest  # noqa: E402
 
 

@@ -63,6 +63,7 @@ def test_cache_auto_enables_on_small_vision_bundle(bundle):
     assert tr._use_device_cache
 
 
+@pytest.mark.slow
 def test_fused_path_cache_bitwise_equal(bundle):
     tr_off, rec_off = _run(bundle, cache="off", dbs=False)
     tr_on, rec_on = _run(bundle, cache="on", dbs=False)
@@ -77,6 +78,7 @@ def test_fused_path_cache_bitwise_equal(bundle):
     )
 
 
+@pytest.mark.slow
 def test_elastic_dbs_cache_bitwise_equal(bundle):
     tr_off, rec_off = _run(bundle, cache="off", dbs=True)
     tr_on, rec_on = _run(bundle, cache="on", dbs=True)
@@ -99,6 +101,6 @@ def test_lm_never_caches(tmp_path):
         bucket=4, bptt=8, device_cache="on",
     )
     tr = LMTrainer(cfg, bundle=corpus, log_to_file=False)
+    # the decision is made at construction; LM training itself is covered by
+    # test_lm_engine — no need to pay a transformer compile here
     assert not tr._use_device_cache
-    rec = tr.run()
-    assert np.isfinite(rec.data["train_loss"]).all()

@@ -24,7 +24,7 @@ def linear_time(plan):
 
 
 def _run(bundle, fused, **kw):
-    cfg = Config(
+    base = dict(
         debug=True,
         world_size=4,
         batch_size=128,
@@ -37,8 +37,9 @@ def _run(bundle, fused, **kw):
         seed=1234,
         bucket=8,
         fused_dbs=fused,
-        **kw,
     )
+    base.update(kw)
+    cfg = Config(**base)
     tr = Trainer(
         cfg,
         bundle=bundle,
@@ -50,6 +51,7 @@ def _run(bundle, fused, **kw):
     return tr, rec
 
 
+@pytest.mark.slow
 def test_fused_dbs_matches_elastic_partitions(bundle):
     tr_e, rec_e = _run(bundle, fused=False)
     tr_f, rec_f = _run(bundle, fused=True)
@@ -163,3 +165,13 @@ def test_fused_dbs_lm_matches_elastic_partitions(corpus):
         assert np.isfinite(rec.data["train_loss"]).all()
     assert tr_f.steps.fused_epoch._cache_size() >= 1
     assert tr_f.steps.worker_step_acc._cache_size() == 0
+
+
+def test_fused_dbs_fast_smoke(bundle):
+    """Fast-tier guard: the capacity-padded scan path engages, runs, and the
+    balancer shifts load off the modeled straggler (the full elastic-parity
+    check is the slow tier's test_fused_dbs_matches_elastic_partitions)."""
+    tr, rec = _run(bundle, fused=True, epoch_size=2, bucket=16)
+    assert tr._can_use_fused_dbs(None), "fused-DBS path did not engage"
+    p = rec.data["partition"][-1]
+    assert p[0] < 0.25 and abs(sum(p) - 1.0) < 1e-9

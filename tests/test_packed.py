@@ -54,6 +54,7 @@ def _run(bundle, packed, dbs=True, **kw):
     return tr, rec
 
 
+@pytest.mark.slow
 def test_packed_engages_and_matches_elastic_partitions(bundle):
     tr_e, rec_e = _run(bundle, packed="off")
     tr_p, rec_p = _run(bundle, packed="auto")
@@ -82,6 +83,7 @@ def test_packed_dbs_off_single_device(bundle):
     assert tr.steps.fused_epoch_idx._cache_size() >= 1
 
 
+@pytest.mark.slow
 def test_packed_without_device_cache_bitwise_equal(bundle):
     """Packed works on datasets too big for the HBM cache (materialized
     windows through the same scan) — and is bitwise-identical to the

@@ -22,17 +22,20 @@ from dynamic_load_balance_distributeddnn_tpu.train import Trainer
 
 
 def _cfg(**kw):
+    # bucket=16 keeps the elastic shape ladder short (4 rungs, not 8) — the
+    # tier's wall here is XLA compiles, not the epochs themselves
     base = dict(
         debug=True,
         world_size=4,
         batch_size=128,
         learning_rate=0.01,
-        epoch_size=8,
+        epoch_size=6,
         dataset="mnist",
         model="mnistnet",
         dynamic_batch_size=True,
-        bucket=8,
-        n_train=512,
+        bucket=16,
+        n_train=256,
+        probe_every=3,
     )
     base.update(kw)
     return Config(**base)
@@ -53,7 +56,7 @@ def _count_probes(tr):
 
 @pytest.fixture(scope="module")
 def bundle():
-    return load_dataset("mnist", n_train=512, n_test=256)
+    return load_dataset("mnist", n_train=256, n_test=256)
 
 
 def test_adaptive_skips_probes_when_stable(bundle):
@@ -64,13 +67,13 @@ def test_adaptive_skips_probes_when_stable(bundle):
         log_to_file=False,
     )
     calls = _count_probes(tr)
-    for e in range(8):
+    for e in range(6):
         tr.run_epoch(e)
     # anchors on 0-1, then the static episode + stable plan skip until the
-    # probe_every=5 schedule fires (epoch 6 = 1 + 5)
+    # probe_every=3 schedule fires (epoch 4 = 1 + 3)
     assert 0 in calls and 1 in calls
     assert len(calls) <= 4, f"adaptive mode probed too often: {calls}"
-    assert not {2, 3, 4, 5} & set(calls), f"skipped window was probed: {calls}"
+    assert not {2, 3} & set(calls), f"skipped window was probed: {calls}"
     # the balancer still converged on MODELED times: worker 0 (3x slower,
     # virtual) ends with roughly a third of a fair share
     assert tr.shares[0] < 0.18, tr.shares
@@ -93,13 +96,13 @@ def test_always_mode_probes_every_epoch(bundle):
 def test_balanced_plan_skips_probes_and_stays_uniform(bundle):
     """The c2 regression case: balanced workers, nothing to balance — epochs
     2+ must not pay for probes, and the partition must stay put."""
-    tr = Trainer(_cfg(), bundle=bundle, log_to_file=False)
+    tr = Trainer(_cfg(epoch_size=4), bundle=bundle, log_to_file=False)
     calls = _count_probes(tr)
     shares = []
-    for e in range(6):
+    for e in range(4):
         tr.run_epoch(e)
         shares.append(tr.shares.copy())
-    assert not {2, 3, 4} & set(calls), calls
+    assert not {2, 3} & set(calls), calls
     for s in shares[1:]:
         # modeled times are noise-free, so the plan must be frozen solid
         np.testing.assert_allclose(s, shares[0], atol=1e-9)

@@ -28,6 +28,7 @@ def _cfg(**kw):
     return Config(**base)
 
 
+@pytest.mark.slow
 def test_sp_ring_trains_and_records(tmp_path):
     tr = SeqParallelLMTrainer(_cfg(stat_dir=str(tmp_path)), log_to_file=False)
     rec = tr.run()

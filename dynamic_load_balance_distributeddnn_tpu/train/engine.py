@@ -202,6 +202,7 @@ class Trainer:
         self._probe_this_epoch = True
         self._next_probe_epoch = 0
         self._probe_sig: Optional[tuple] = None
+        self._probe_episode: Optional[tuple] = None
         self._probe_wall_ref: Optional[float] = None
         self._slow_streak = 0
         self._sync_per_step = 0.0  # last probed elastic sync cost, reused on skips
@@ -641,14 +642,48 @@ class Trainer:
     # ------------------------------------------------------ probe scheduling
 
     def _epoch_signature(self, plan, faults: EpochFaults) -> tuple:
-        """What the model must track to stay valid: the plan's batch layout
-        and the injection episode state."""
+        """What the wall-reference comparison must hold fixed: the plan's
+        batch layout and the realized injection arrays."""
         return (
             tuple(int(b) for b in plan.batch_sizes),
             tuple(int(s) for s in faults.slow_iters_per_step),
             tuple(float(m) for m in faults.time_multipliers),
             tuple(float(v) for v in faults.virtual_seconds),
         )
+
+    def _episode_state(self, plan, faults: EpochFaults):
+        """Plan-NORMALIZED injection state for the episode-change trigger.
+        Compute-mode slow_iters scale with each worker's batch (the injector
+        sizes them off ctx.batch_sizes), so comparing raw iters would read
+        every rebalance as a new episode and degrade adaptive mode into
+        per-epoch probing — the defect artifacts/SMOOTHING.json's arm B
+        caught. The per-example iteration ratio is plan-invariant."""
+        raw = np.asarray(faults.slow_iters_per_step, dtype=np.float64)
+        ratio = raw / np.maximum(np.asarray(plan.batch_sizes, dtype=np.float64), 1.0)
+        return (
+            ratio,
+            raw,
+            np.asarray(faults.time_multipliers, dtype=np.float64),
+            np.asarray(faults.virtual_seconds, dtype=np.float64),
+        )
+
+    def _episode_changed(self, plan, faults: EpochFaults) -> bool:
+        if self._probe_episode is None:
+            return False
+        ratio, raw, mult, virt = self._episode_state(plan, faults)
+        r0, w0, m0, v0 = self._probe_episode
+        if not np.array_equal(mult, m0) or not np.allclose(virt, v0, rtol=0.05, atol=1e-9):
+            return True
+        # A real episode change moves BOTH views of the injected load; a mere
+        # rebalance moves only one. Batch-scaled injectors (StaticStraggler)
+        # keep the per-example ratio fixed across rebalances while raw iters
+        # move; wall-seconds injectors (the random fault episodes,
+        # faults.py:117) keep raw iters fixed while the ratio moves. 25%
+        # relative hysteresis absorbs integer-rounding jitter; on/off
+        # transitions trip both terms via the +eps guard.
+        ratio_moved = np.abs(ratio - r0) > 0.25 * r0 + 1e-9
+        raw_moved = np.abs(raw - w0) > 0.25 * w0 + 1e-9
+        return bool(np.any(ratio_moved & raw_moved))
 
     def _should_probe(self, epoch: int, plan, faults: EpochFaults) -> bool:
         """Adaptive probe schedule (config.probe_mode): real per-worker probe
@@ -673,12 +708,10 @@ class Trainer:
             want = True
         elif self._needs_iter_cost and self._iter_cost_s is None:
             want = True
+        elif self._episode_changed(plan, faults):
+            want = True  # injection episode changed — re-anchor on reality
         else:
-            sig = self._epoch_signature(plan, faults)
-            if self._probe_sig is not None and sig[1:] != self._probe_sig[1:]:
-                want = True  # injection episode changed — re-anchor on reality
-            else:
-                want = epoch >= self._next_probe_epoch
+            want = epoch >= self._next_probe_epoch
         if self.n_proc > 1:
             # _probe_workers ends in the mesh-wide combine_probe collective,
             # so the decision MUST be identical on every process; the local
@@ -714,12 +747,24 @@ class Trainer:
         sig = self._epoch_signature(plan, faults)
         if self._probe_this_epoch:
             self._probe_sig = sig
+            self._probe_episode = self._episode_state(plan, faults)
             # reference wall excludes the probe cost itself, so skipped
             # epochs (zero probe cost) compare apples-to-apples
             self._probe_wall_ref = epoch_wall - train_metrics.get(
                 "dbs_probe_cost", 0.0
             )
             self._next_probe_epoch = epoch + max(cfg.probe_every, 1)
+            self._slow_streak = 0
+        elif self._probe_wall_ref and sig != self._probe_sig:
+            # the plan changed on a skipped epoch (model-driven rebalance):
+            # the stored wall no longer describes this plan, so RE-BASE the
+            # reference on this epoch's wall — otherwise the slowdown
+            # trigger would be inert until the next probe_every anchor on
+            # exactly the epochs adaptive mode newly skips. (If a genuine
+            # slowdown starts the same epoch it gets baked into the ref and
+            # is only caught by the anchor — bounded by probe_every.)
+            self._probe_sig = sig
+            self._probe_wall_ref = epoch_wall
             self._slow_streak = 0
         elif self._probe_wall_ref and sig == self._probe_sig:
             if epoch_wall > (1.0 + cfg.probe_wall_tol) * self._probe_wall_ref:

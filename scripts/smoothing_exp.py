@@ -27,6 +27,7 @@ import sys
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "./.jax_cache")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
@@ -48,6 +49,7 @@ def main() -> int:
     ap.add_argument("--epochs", type=int, default=8)
     ap.add_argument("--ntrain", type=int, default=60_000)
     ap.add_argument("--straggler", default="3,1,1,1")
+    ap.add_argument("--arms", default="", help="comma list of arm names to (re)run")
     args = ap.parse_args()
 
     import jax
@@ -63,7 +65,22 @@ def main() -> int:
         "C_always_ema05": dict(probe_mode="always", time_smoothing=0.5),
     }
     out = {"config": vars(args), "arms": {}}
+    if os.path.exists("artifacts/SMOOTHING.json"):
+        try:
+            with open("artifacts/SMOOTHING.json") as f:
+                out["arms"] = json.load(f).get("arms", {})
+        except Exception:
+            pass
+    if args.arms:
+        selected = {a.strip() for a in args.arms.split(",") if a.strip()}
+        unknown = selected - set(arms)
+        if unknown:
+            raise SystemExit(f"unknown arms {sorted(unknown)}; choose from {sorted(arms)}")
+    else:
+        selected = None
     for name, kw in arms.items():
+        if selected is not None and name not in selected:
+            continue
         cfg = Config(
             debug=False,
             world_size=4,
@@ -88,6 +105,9 @@ def main() -> int:
             parts.append(tr.shares.tolist())
             times.append([round(t, 4) for t in tr.node_times.tolist()])
         out["arms"][name] = {
+            # per-arm config snapshot: merged re-runs of single arms must not
+            # let stale arms masquerade as results for the current argv
+            "config": vars(args),
             "partitions": [[round(x, 4) for x in p] for p in parts],
             "node_times": times,
             "churn": churn(parts),

@@ -19,13 +19,26 @@ import numpy as np
 
 def load(path):
     if path.endswith(".npy"):
-        return np.load(path, allow_pickle=True).item()
+        d = np.load(path, allow_pickle=True).item()
+        # the JSON sidecar carries run-level _meta (data provenance) that the
+        # reference-parity .npy payload deliberately omits
+        sidecar = path[:-4] + ".json"
+        if os.path.exists(sidecar):
+            try:
+                with open(sidecar) as f:
+                    d["_meta"] = json.load(f).get("_meta", {})
+            except Exception:
+                pass
+        return d
     with open(path) as f:
         return json.load(f)
 
 
 def fmt_run(name, d):
     rows = []
+    meta = d.get("_meta") or {}
+    if meta.get("synthetic"):
+        name += "   [SYNTHETIC DATA — accuracies not comparable to real sets]"
     n = len(d.get("epoch", []))
     for e in range(n):
         part = np.asarray(d["partition"][e], dtype=float)
@@ -71,12 +84,17 @@ def main(argv):
             continue
         on_w = np.diff([0.0] + list(d["wallclock_time"]))
         off_w = np.diff([0.0] + list(off["wallclock_time"]))
-        # steady state: skip the calibration epoch (and first reaction, on-arm)
-        on_s = float(np.min(on_w[2:])) if len(on_w) > 2 else float(on_w[-1])
-        off_s = float(np.min(off_w[1:])) if len(off_w) > 1 else float(off_w[-1])
+        # steady state: skip the calibration epoch (and first reaction, on-arm);
+        # median headline + min alongside, like bench.py's hardened statistic
+        on_win = on_w[2:] if len(on_w) > 2 else on_w[-1:]
+        off_win = off_w[1:] if len(off_w) > 1 else off_w[-1:]
+        on_med, off_med = float(np.median(on_win)), float(np.median(off_win))
+        on_min, off_min = float(np.min(on_win)), float(np.min(off_win))
         print(
             f"A/B {name.split('-node')[0]}: steady epoch "
-            f"on={on_s:.3f}s off={off_s:.3f}s speedup={off_s / max(on_s, 1e-9):.2f}x "
+            f"on={on_med:.3f}s off={off_med:.3f}s "
+            f"speedup(median)={off_med / max(on_med, 1e-9):.2f}x "
+            f"speedup(min)={off_min / max(on_min, 1e-9):.2f}x "
             f"acc on/off={d['accuracy'][-1]:.2f}/{off['accuracy'][-1]:.2f}"
         )
     return 0

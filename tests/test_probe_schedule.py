@@ -152,3 +152,20 @@ def test_skipped_epochs_report_cached_sync_time(bundle):
     # epoch 2-3 skip probes but must report the last probed per-step sync
     # scaled by their own step counts, not zero
     assert all(s > 0 for s in sync[2:]), sync
+
+
+def test_adaptive_skips_with_compute_injection(bundle):
+    """Regression (artifacts/SMOOTHING.md arm B, first run): compute-mode
+    slow_iters scale with each worker's batch, so a naive episode signature
+    read every rebalance as a new episode and probed every epoch. The
+    plan-normalized iters-per-example ratio must keep skipping."""
+    tr = Trainer(
+        _cfg(epoch_size=5, fault_mode="compute", fault_tolerance=True),
+        bundle=bundle,
+        injector=StaticStragglerInjector([3, 1, 1, 1], mode="compute"),
+        log_to_file=False,
+    )
+    calls = _count_probes(tr)
+    for e in range(5):
+        tr.run_epoch(e)
+    assert not {2, 3} & set(calls), f"rebalance misread as episode change: {calls}"

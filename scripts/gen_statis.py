@@ -118,7 +118,16 @@ def main() -> int:
             if ws >= 4:  # >=2 devices, >=2 workers/device: elastic, not packed
                 base += ["-gpu", ",".join(str(i // 2) for i in range(ws))]
         n_train = LM_NTRAIN if name == "c5_transformer" else NTRAIN
-        for dbs in ("true", "false"):
+        # STATIS_ARM_ORDER=false_first flips the arms: running the A/B in
+        # both orders exposes host-throughput drift between the two arms'
+        # time windows (sequential arms on a noisy 1-core box can differ
+        # several % for identical work)
+        arm_order = (
+            ("false", "true")
+            if os.environ.get("STATIS_ARM_ORDER") == "false_first"
+            else ("true", "false")
+        )
+        for dbs in arm_order:
             args = base + [
                 "-dbs", dbs,
                 "-e", str(EPOCHS),

@@ -29,7 +29,8 @@ Instrumentation: examples/s and MFU (obs/flops.py, XLA cost model vs chip
 bf16 peak) from the trainer's recorder extras, reported in `detail`.
 
 Knobs: BENCH_NTRAIN (12800), BENCH_EPOCHS (7), BENCH_WS (4), BENCH_RETRIES
-(3), BENCH_TOTAL_BUDGET (5400s), BENCH_ARM_RESERVE (1800s),
+(3), BENCH_STALL_S (900s, in-subprocess heartbeat-stall watchdog),
+BENCH_TOTAL_BUDGET (5400s), BENCH_ARM_RESERVE (1800s),
 BENCH_INIT_TIMEOUT (2700s, in-subprocess init watchdog),
 BENCH_PREFLIGHT_TIMEOUTS, BENCH_FORCE_CPU=1 (skip TPU entirely),
 BENCH_CPU_INSURANCE=0 (disable the fallback).
@@ -124,6 +125,23 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
 
     jax.devices()
     done.set()
+
+    # Stall watchdog: a tunnel drop mid-run leaves PJRT blocked in C++ at 0%
+    # CPU (observed: 45 min hung in the warm loop, round 3). The engine
+    # heartbeats whenever the device answers; if neither the heartbeat nor
+    # the incremental result file advances for BENCH_STALL_S, hard-exit so
+    # the orchestrator retries instead of burning the budget.
+    from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import (
+        arm_stall_watchdog,
+    )
+
+    arm_stall_watchdog(
+        out_path + ".hb",
+        # default clears a cold whole-epoch XLA compile (~8-10 min observed)
+        # with margin; a genuine hang then costs 15 min, not the whole budget
+        float(os.environ.get("BENCH_STALL_S", 900)),
+        extra_paths=(out_path,),
+    )
 
     from dynamic_load_balance_distributeddnn_tpu.config import Config
     from dynamic_load_balance_distributeddnn_tpu.data import load_dataset

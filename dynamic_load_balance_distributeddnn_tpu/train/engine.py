@@ -60,6 +60,7 @@ from dynamic_load_balance_distributeddnn_tpu.ops.faultload import calibrate_iter
 from dynamic_load_balance_distributeddnn_tpu.ops.losses import example_weights
 from dynamic_load_balance_distributeddnn_tpu.parallel import WorkerTopology, data_mesh
 from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import replicated_sharding
+from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import heartbeat
 from dynamic_load_balance_distributeddnn_tpu.train.schedule import one_cycle_lr
 from dynamic_load_balance_distributeddnn_tpu.train.state import create_state, make_optimizer
 from dynamic_load_balance_distributeddnn_tpu.train.steps import (
@@ -408,6 +409,7 @@ class Trainer:
                 if warm_acc:
                     acc, aux = step_acc(views[d], acc, *args)
                 jax.block_until_ready(aux)
+                heartbeat()  # one ladder compile done — the watchdog's unit
         self.logger.info(
             f"Warm start: compiled {len(ladder)} batch shapes "
             f"(up to {max_b}) in {time.perf_counter() - t0:.1f}s"
@@ -620,6 +622,7 @@ class Trainer:
                 if u is not None:
                     extras["mfu_bf16_peak"] = u
 
+        heartbeat()  # epoch complete — device answered end-to-end
         self.recorder.record_epoch(
             epoch=epoch,
             train_loss=train_metrics["loss"],
@@ -1000,6 +1003,7 @@ class Trainer:
                         self.state, xs, ys, ws_, slow, seed
                     )
                 metrics_total += np.asarray(jax.block_until_ready(metrics))
+                heartbeat()
         metrics = metrics_total
         probe_overhead = 0.0
         if self._fused_sync_per_step is None:
@@ -1098,6 +1102,7 @@ class Trainer:
                 t0 = time.perf_counter()
                 jax.block_until_ready(fn(*args))
                 best = min(best, time.perf_counter() - t0)
+            heartbeat()
             return best
 
         t_full = timed(self.steps.fused_step_probe, self.state, x0, y0, w0, slow, seed)
@@ -1258,6 +1263,7 @@ class Trainer:
         data = first_data  # probes below reuse the first window's batches
 
         jax.block_until_ready(self.state.params)
+        heartbeat()  # epoch pipeline drained
         # Probe AFTER the epoch's async pipeline has drained, so per-worker
         # timings measure that worker's executable alone, not queueing noise.
         # Compute-mode fault injection needs the probes too (per-example cost
@@ -1381,6 +1387,7 @@ class Trainer:
         for r, (args, d) in staged.items():
             _, aux = probe_step(views[d], *args)
             jax.block_until_ready(aux)
+            heartbeat()
         partials = {}
         for d in topo.used_device_indices:
             acc = None
@@ -1395,6 +1402,7 @@ class Trainer:
                     acc, aux = probe_step(views[d], *args)
                     jax.block_until_ready(aux)
                     dt = min(dt, time.perf_counter() - t0)
+                heartbeat()
                 w_plan = plan.workers[gr]
                 self.timekeeper.add_compute(gr, dt * w_plan.steps)
                 slow_n = float(faults.slow_iters_per_step[gr])
@@ -1480,6 +1488,7 @@ class Trainer:
                 _, aux = probe_step(views[d], *test_args)
                 jax.block_until_ready(aux)
                 dt = min(dt, time.perf_counter() - t0)
+            heartbeat()
             realized = (dt - clean) / slow_n
             if realized <= 0 or not np.isfinite(realized):
                 break
@@ -1542,6 +1551,7 @@ class Trainer:
             nonlocal loss_sum, correct, count
             stats = self.steps.fused_eval_step(self.state.params, xb, yb, mb)
             stats = np.asarray(jax.block_until_ready(stats))
+            heartbeat()
             loss_sum += float(stats[0])
             correct += float(stats[1])
             count += float(stats[2])

@@ -83,6 +83,18 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")  # beats the axon TPU plugin
 
     from dynamic_load_balance_distributeddnn_tpu import cli
+    from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import (
+        arm_stall_watchdog,
+    )
+
+    # A dropped TPU tunnel leaves PJRT hung in C++ (0% CPU, uninterruptible);
+    # the engine heartbeats per compile/probe/epoch, so a stale heartbeat
+    # means a dead backend — exit and let the queue retry on the next window.
+    if os.environ.get("STATIS_CPU") != "1":
+        arm_stall_watchdog(
+            os.path.join(ns.out_dir, ".hb"),
+            float(os.environ.get("STATIS_STALL_S", 1200)),
+        )
 
     stat_dir = os.path.join(ns.out_dir, "statis")
     log_dir = os.path.join(ns.out_dir, "logs")

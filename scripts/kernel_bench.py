@@ -210,6 +210,18 @@ def main():
     os.makedirs(ns.out_dir, exist_ok=True)
     json_path = os.path.join(ns.out_dir, f"kernel_bench_{platform}.json")
 
+    # Tunnel-drop armor: rows persist incrementally to json_path; if no row
+    # lands for KB_STALL_S the backend is hung — exit so the queue retries.
+    from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import (
+        arm_stall_watchdog,
+    )
+
+    arm_stall_watchdog(
+        json_path + ".hb",
+        float(os.environ.get("KB_STALL_S", 900)),
+        extra_paths=(json_path,),
+    )
+
     class _IncrementalResults(list):
         """Persist after every row — a runtime outage mid-bench (the TPU
         tunnel can drop) must not lose completed measurements."""

@@ -75,10 +75,24 @@ def main() -> int:
     wd = _install_watchdog(float(os.environ.get("MFU_INIT_CAP_S", 1800)))
     import jax
 
+    from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import (
+        arm_stall_watchdog,
+        heartbeat,
+    )
+
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
     devs = jax.devices()
     wd.cancel()
+    # Tunnel-drop armor, armed AFTER backend init so MFU_INIT_CAP_S keeps
+    # sole authority over the init window. TPU-only: CPU fused-scan compiles
+    # can out-wait any reasonable stall cap without a heartbeat.
+    if devs[0].platform != "cpu":
+        arm_stall_watchdog(
+            OUT + ".hb",
+            float(os.environ.get("MFU_STALL_S", 1200)),
+            extra_paths=(OUT,),
+        )
     import jax.numpy as jnp
     import numpy as np
 
@@ -109,6 +123,7 @@ def main() -> int:
             t0 = time.perf_counter()
             jax.block_until_ready(fn(*args))
             best = min(best, time.perf_counter() - t0)
+        heartbeat()
         return best
 
     n = 4096 if not quick else 1024

@@ -77,6 +77,21 @@ def main():
     platform, kind = dev.platform, getattr(dev, "device_kind", "?")
     print(f"[precision_bench] {platform}/{kind}", flush=True)
 
+    # Mid-run tunnel drops hang PJRT at 0% CPU; the engine heartbeats per
+    # epoch/probe, so a stale heartbeat means a dead backend — fail fast.
+    # TPU-only: the XLA CPU backend's fused whole-epoch scan can legitimately
+    # compile for 30+ min with no heartbeat (see gen_statis STATIS_FORCE_
+    # ELASTIC note), which would false-trigger the stall check.
+    if platform != "cpu":
+        from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import (
+            arm_stall_watchdog,
+        )
+
+        arm_stall_watchdog(
+            os.path.join(ns.out_dir, ".precision.hb"),
+            float(os.environ.get("PRECISION_STALL_S", 1200)),
+        )
+
     results = {}
     for prec in ("float32", "bfloat16"):
         t0 = time.time()

@@ -1,0 +1,79 @@
+"""Stall-watchdog unit tests (runtime/watchdog.py).
+
+The watchdog turns a dead-tunnel PJRT hang (0% CPU, uninterruptible in C++)
+into a bounded subprocess failure. These tests pin its contract: heartbeat is
+a no-op unless configured, arming creates missing parents, a fresh heartbeat
+holds the process alive, and a stale one hard-exits with the chosen code —
+including when the heartbeat file could not be created at all (fail-closed).
+"""
+
+import os
+import subprocess
+import sys
+
+from dynamic_load_balance_distributeddnn_tpu.runtime import watchdog
+
+
+def test_heartbeat_noop_without_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("DBS_HEARTBEAT_FILE", raising=False)
+    watchdog.heartbeat()  # must not raise or create anything
+
+
+def test_heartbeat_touches_configured_file(tmp_path, monkeypatch):
+    hb = tmp_path / "hb"
+    monkeypatch.setenv("DBS_HEARTBEAT_FILE", str(hb))
+    watchdog.heartbeat()
+    assert hb.exists()
+
+
+def test_arm_creates_missing_parent(tmp_path, monkeypatch):
+    monkeypatch.delenv("DBS_HEARTBEAT_FILE", raising=False)
+    hb = tmp_path / "not" / "yet" / "there" / "hb"
+    t = watchdog.arm_stall_watchdog(str(hb), stall_s=10_000, poll_s=10_000)
+    assert t.daemon
+    assert hb.exists()
+    assert os.environ["DBS_HEARTBEAT_FILE"] == str(hb)
+
+
+_CHILD = r"""
+import sys, time
+from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import (
+    arm_stall_watchdog, heartbeat,
+)
+mode = sys.argv[1]
+hb = sys.argv[2]
+arm_stall_watchdog(hb, stall_s=1.0, poll_s=0.2, exit_code=19)
+if mode == "alive":
+    for _ in range(10):
+        time.sleep(0.3)
+        heartbeat()
+    sys.exit(0)
+time.sleep(30)  # "hang": no heartbeats -> watchdog must fire
+sys.exit(0)
+"""
+
+
+def _run_child(mode: str, hb: str, timeout: float = 20):
+    return subprocess.run(
+        [sys.executable, "-c", _CHILD, mode, hb],
+        timeout=timeout,
+        env={**os.environ, "PYTHONPATH": os.getcwd()},
+    )
+
+
+def test_stale_heartbeat_hard_exits(tmp_path):
+    proc = _run_child("hang", str(tmp_path / "hb"))
+    assert proc.returncode == 19
+
+
+def test_fresh_heartbeat_keeps_process_alive(tmp_path):
+    proc = _run_child("alive", str(tmp_path / "hb"))
+    assert proc.returncode == 0
+
+
+def test_fails_closed_when_hb_uncreatable(tmp_path):
+    # a path that cannot exist (parent is a FILE) -> watchdog must still fire
+    blocker = tmp_path / "f"
+    blocker.write_text("x")
+    proc = _run_child("hang", str(blocker / "hb"))
+    assert proc.returncode == 19

@@ -1388,6 +1388,20 @@ class Trainer:
             _, aux = probe_step(views[d], *args)
             jax.block_until_ready(aux)
             heartbeat()
+
+        def timed(d: int, args2):
+            """(min-over-reps blocking wall, last partial) of one probe step."""
+            dt, acc = float("inf"), None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                acc, aux = probe_step(views[d], *args2)
+                jax.block_until_ready(aux)
+                dt = min(dt, time.perf_counter() - t0)
+            heartbeat()
+            return dt, acc
+
+        lo, hi = self.rank_lo, self.rank_lo + self.ws_local
+        init_epoch = bool(np.isnan(self.per_example_cost[lo:hi]).any())
         partials = {}
         for d in topo.used_device_indices:
             acc = None
@@ -1396,19 +1410,14 @@ class Trainer:
                 gr = self.rank_lo + r
                 # probe with the non-donating first-step executable so reps
                 # are safe; each worker is measured standalone
-                dt = float("inf")
-                for _ in range(reps):
-                    t0 = time.perf_counter()
-                    acc, aux = probe_step(views[d], *args)
-                    jax.block_until_ready(aux)
-                    dt = min(dt, time.perf_counter() - t0)
-                heartbeat()
+                dt, acc = timed(d, args)
                 w_plan = plan.workers[gr]
                 self.timekeeper.add_compute(gr, dt * w_plan.steps)
                 slow_n = float(faults.slow_iters_per_step[gr])
                 if np.isnan(self.per_example_cost[gr]):
-                    # First (injection-free) measurement IS the clean cost;
-                    # it stays frozen. Re-deriving it every epoch by
+                    # First (injection-free) measurement seeds the clean
+                    # cost; the refresh pass below re-anchors it fully warm,
+                    # then it stays frozen. Re-deriving it every epoch by
                     # subtracting estimated injected cost is a positive
                     # feedback loop: any underestimate of the in-step
                     # iteration cost inflates "clean", which inflates next
@@ -1416,28 +1425,62 @@ class Trainer:
                     self.per_example_cost[gr] = max(dt, 1e-9) / max(
                         w_plan.batch_size, 1
                     )
-                elif slow_n > 0:
-                    # Closed-loop iteration-cost calibration: the standalone
-                    # calibrated cost can differ from the in-step cost (e.g.
-                    # shared host thread pools on the CPU mesh); the realized
-                    # cost (measured minus frozen clean, per iter) converges
-                    # injection to the requested factors on any backend.
-                    clean = self.per_example_cost[gr] * w_plan.batch_size
-                    realized = (dt - clean) / slow_n
+                elif slow_n > 0 and not self._iter_cost_calibrated:
+                    # Closed-loop iteration-cost tracking, ONLY until the
+                    # fixed-point calibration has run. Two lessons from the
+                    # round-3 TPU A/B (off-arm walls ramped 1.8->2.5s over 5
+                    # "equal-injection" epochs):
+                    #  - realized cost must come from a PAIRED measurement
+                    #    (injected minus fresh-uninjected, below), not from
+                    #    the frozen epoch-0 clean anchor: session drift
+                    #    (tunnel RPC latency settling, chip clocks) between
+                    #    the anchor and dt otherwise leaks into the estimate
+                    #    and the EMA pumps slow_n without bound;
+                    #  - once calibrated, the cost stays FROZEN so every
+                    #    counted epoch injects the same strength — the A/B
+                    #    contract the bench asserts per arm.
+                    zero = jax.device_put(jnp.int32(0), topo.devices[d])
+                    dt_clean, _ = timed(d, args[:-1] + (zero,))
+                    realized = (dt - dt_clean) / slow_n
                     if realized > 0 and np.isfinite(realized):
                         prev = self._iter_cost_s or realized
                         self._iter_cost_s = 0.5 * prev + 0.5 * realized
-                else:
+                elif slow_n == 0:
                     # Uninjected re-probe: drift the clean-cost anchor slowly
                     # toward reality so the adaptive scheduler's model tracks
                     # genuine speed changes. No feedback risk — injected
-                    # measurements never enter this branch, so the injection
-                    # calibration's anchor stays independent of it.
+                    # measurements never enter this branch (explicitly gated:
+                    # an injected dt leaking in here compounds into runaway
+                    # slow_iters), so the calibration anchor stays clean.
                     fresh = max(dt, 1e-9) / max(w_plan.batch_size, 1)
                     self.per_example_cost[gr] = (
                         0.7 * self.per_example_cost[gr] + 0.3 * fresh
                     )
             partials[d] = acc
+        if init_epoch:
+            # Anchor-refresh pass: the very first timed probes run cold
+            # (allocator, host caches, tunnel RPC settling) and over-read the
+            # clean cost ~2x (measured on both the CPU mesh and the TPU
+            # tunnel). One more pass, now fully warm, re-anchors every
+            # uninjected worker BEFORE the calibration sizes the injection
+            # off these anchors — otherwise the straggler factors are scaled
+            # against an inflated "clean" and overshoot for the whole run
+            # (anchors freeze after this epoch).
+            for d in topo.used_device_indices:
+                for r in topo.groups[d]:
+                    gr = self.rank_lo + r
+                    args, _ = staged[r]
+                    if float(faults.slow_iters_per_step[gr]) != 0:
+                        # a worker can be injected on its very first probed
+                        # epoch (LuckyFaultInjector seeds iter cost from the
+                        # standalone estimate) — its anchor was seeded from a
+                        # cold AND injected dt; re-anchor on a zero-slow probe
+                        zero = jax.device_put(jnp.int32(0), topo.devices[d])
+                        args = args[:-1] + (zero,)
+                    dt, _ = timed(d, args)
+                    self.per_example_cost[gr] = max(dt, 1e-9) / max(
+                        plan.workers[gr].batch_size, 1
+                    )
         if (
             self._needs_iter_cost
             and not self._iter_cost_calibrated
@@ -1450,7 +1493,7 @@ class Trainer:
             # arms at different injection strengths (the early weak-injection
             # epochs win every min(), systematically favoring whichever arm
             # sampled more of them).
-            self._calibrate_iter_cost(staged, views, probe_step, plan, reps)
+            self._calibrate_iter_cost(staged, timed, plan)
             self._iter_cost_calibrated = True
         stacked = stack_partials(
             [partials[d] for d in topo.used_device_indices], self.mesh
@@ -1462,12 +1505,15 @@ class Trainer:
         jax.block_until_ready(probed.params)
         return time.perf_counter() - t0
 
-    def _calibrate_iter_cost(self, staged, views, probe_step, plan, reps: int) -> None:
+    def _calibrate_iter_cost(self, staged, timed, plan) -> None:
         """Fixed-point iteration for the in-step synthetic-load cost: probe a
         step with a test trip count sized to ~double the clean step time,
         measure the realized per-iteration cost, and repeat until stable
         (each realized measurement IS the quantity being estimated, so this
-        converges in 1-2 rounds). Runs on one worker, a handful of probe
+        converges in 1-2 rounds). ``timed`` is _probe_workers' own probe
+        timer, so calibration measures EXACTLY like the per-epoch tracking
+        path — an asymmetry between the two is the kind of drift that caused
+        the round-3 injection ramp. Runs on one worker, a handful of probe
         steps — calibration-epoch overhead only."""
         r0 = next(iter(staged))
         args, d = staged[r0]
@@ -1479,17 +1525,21 @@ class Trainer:
             return
         dev = self.topology.devices[d]
         guess = self._iter_cost_s or calibrate_iter_cost()
+
+        def timed_probe(slow_n: int) -> float:
+            test_args = args[:-1] + (jax.device_put(jnp.int32(slow_n), dev),)
+            return timed(d, test_args)[0]
+
         for _ in range(4):
             slow_n = max(int(round(clean / max(guess, 1e-12))), 1)
-            test_args = args[:-1] + (jax.device_put(jnp.int32(slow_n), dev),)
-            dt = float("inf")
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                _, aux = probe_step(views[d], *test_args)
-                jax.block_until_ready(aux)
-                dt = min(dt, time.perf_counter() - t0)
-            heartbeat()
-            realized = (dt - clean) / slow_n
+            # PAIRED measurement: a fresh uninjected step in the same breath,
+            # so the delta isolates the synthetic load from session drift
+            # (the frozen epoch-0 clean anchor bakes in early-session tunnel
+            # latency — subtracting it mis-measured the realized cost ~3x on
+            # the round-3 TPU run and the closed loop ramped injection).
+            dt = timed_probe(slow_n)
+            dt_clean = timed_probe(0)
+            realized = (dt - dt_clean) / slow_n
             if realized <= 0 or not np.isfinite(realized):
                 break
             done = abs(realized - guess) <= 0.05 * guess

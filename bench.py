@@ -215,10 +215,18 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
             fault_mode="compute",
             bucket=bucket,
             precision=precision,
-            # pre-compile the bucketed shape ladder so rebalance epochs never
-            # pay an XLA compile inside a timed wall (the balancer's win would
-            # otherwise drown in compile noise on short runs)
-            warm_start=dbs_on,
+            # TPU (1 chip): NO warm ladder — both arms run the packed path,
+            # whose window is the same [n, cap] shape through the same
+            # fused_epoch_idx executable for every plan (tight _cap_packed),
+            # so ONE compile — paid in excluded epoch 0 — serves both arms;
+            # probe shapes self-warm untimed inside _probe_workers. The
+            # elastic ladder warm_start used to trigger (16 DenseNet
+            # compiles) burned 15-40 min of tunnel window for executables
+            # this topology never times. CPU insurance (4-device mesh):
+            # compute-mode injection forces the ELASTIC path there, where
+            # fresh rebalanced shapes would compile inside timed walls — the
+            # ladder warm stays.
+            warm_start=dbs_on and force_cpu,
         )
         tr = Trainer(
             cfg,

@@ -839,17 +839,20 @@ class Trainer:
         Without snapping, per-worker ceil padding can exceed B; keep the
         conservative cap there (_can_use_packed enforces the width bound)."""
         cfg = self.cfg
-        if (
-            cfg.dynamic_batch_size
-            and cfg.snap_to_bucket
-            and self.SNAP_BATCHES
-            and cfg.batch_size // cfg.bucket >= cfg.world_size
-        ):
+        B, ws, bucket = cfg.batch_size, cfg.world_size, cfg.bucket
+        if not cfg.dynamic_batch_size:
+            # dbs off: the only plan is the uniform integer split — its exact
+            # packed width is a static bound. At bucket-divisible shapes this
+            # equals the dbs-on tight cap, so the A/B arms (and the clean
+            # leg) share one executable with identical dead-row cost: zero.
+            per_batch = -(-B // ws)  # ceil: the largest worker batch
+            return ws * (-(-per_batch // bucket) * bucket)
+        if cfg.snap_to_bucket and self.SNAP_BATCHES and B // bucket >= ws:
             # every dbs plan (incl. the epoch-0 uniform one) passes through
             # quantize_batches under exactly these conditions — unsnapped
-            # plans (dbs off / snapping not applicable) keep the slack cap
-            return -(-cfg.batch_size // cfg.bucket) * cfg.bucket
-        return cfg.batch_size + cfg.world_size * cfg.bucket
+            # dbs plans keep the slack cap
+            return -(-B // bucket) * bucket
+        return B + ws * bucket
 
     def _can_use_packed(self, plan) -> bool:
         """Single-device packed epochs: all workers share ONE chip (the

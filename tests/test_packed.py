@@ -147,3 +147,36 @@ def test_packed_measured_signal_converges(bundle):
     final = np.array(rec.data["partition"][-1])
     assert final[0] < 0.25 - 0.04, f"straggler share did not drop: {rec.data['partition']}"
     assert final.sum() == pytest.approx(1.0)
+
+
+def test_cap_packed_symmetric_and_tight(bundle):
+    """Both A/B arms (dbs on/off) must share the same zero-dead-row packed
+    width at bucket-divisible shapes — the round-3 on-chip A/B was biased
+    when the off arm padded to B + ws*bucket (20% dead rows) while the on
+    arm ran tight. Non-divisible dbs-off splits keep their exact width."""
+
+    def cap(ws, batch, dbs, bucket=32):
+        cfg = Config(
+            debug=True,
+            world_size=ws,
+            batch_size=batch,
+            learning_rate=0.01,
+            epoch_size=1,
+            dataset="mnist",
+            model="mnistnet",
+            dynamic_batch_size=dbs,
+            bucket=bucket,
+            device=0,
+        )
+        return Trainer(cfg, bundle=bundle, log_to_file=False)._cap_packed
+
+    # bench shape: identical executables for on and off arms, zero padding
+    assert cap(4, 512, True) == 512
+    assert cap(4, 512, False) == 512
+    # c4 shape (ws=8)
+    assert cap(8, 512, True) == 512
+    assert cap(8, 512, False) == 512
+    # non-divisible uniform split: exact (ceil-per-worker) width, no slack
+    assert cap(3, 512, False) == 3 * 192
+    # snapping infeasible (fewer buckets than workers): conservative cap
+    assert cap(4, 64, True, bucket=32) == 64 + 4 * 32

@@ -37,7 +37,7 @@ leg () {  # leg <name> <timeout_s> <cmd...>
 }
 
 all_done () {
-  for n in bench mfu flash kernels statis precision; do
+  for n in bench mfu flash kernels statis precision statis_c5; do
     [ -f "$STAMPS/$n.done" ] || return 1
   done
   return 0
@@ -60,10 +60,19 @@ while true; do
     leg flash 1500 env RUN_TPU_TESTS=1 python -m pytest \
       tests/test_pallas.py::test_flash_nondefault_blocks_real_tpu -q || continue
     leg kernels 2400 python scripts/kernel_bench.py --repeats 30 || continue
-    leg statis 14400 env STATIS_ONLY=c2_resnet18,c3_densenet,c4_regnet_ws8 STATIS_WARM=true \
+    # STATIS_WARM=false: the queued configs are all 1-chip vision -> packed
+    # path, where the elastic warm ladder compiles executables the run never
+    # times (probes self-warm untimed); the ladder would burn 30-90 min of
+    # tunnel window across the three model families
+    leg statis 14400 env STATIS_ONLY=c2_resnet18,c3_densenet,c4_regnet_ws8 STATIS_WARM=false \
       sh -c 'python scripts/gen_statis.py --out_dir artifacts/acceptance >> /tmp/gen_statis_tpu.log 2>&1' \
       || continue
     leg precision 3600 python scripts/precision_bench.py || continue
+    # bonus leg (after every VERDICT item): the LM acceptance config on chip,
+    # completing the full BASELINE matrix c1-c5 (c1 runs fine on CPU tier)
+    leg statis_c5 7200 env STATIS_ONLY=c5_transformer STATIS_WARM=false \
+      sh -c 'python scripts/gen_statis.py --out_dir artifacts/acceptance >> /tmp/gen_statis_tpu.log 2>&1' \
+      || continue
   else
     echo "[queue3] TPU down at $(date -u +%H:%M:%S); sleeping 120s"
     sleep 120

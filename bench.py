@@ -171,6 +171,7 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
         "n_train": n_train,
         "model": model,
         "world_size": ws,
+        "straggler_factors": factors,
         "off": [],
         "on": [],
         "instr": {},
@@ -348,7 +349,11 @@ def _result_from(partial) -> dict | None:
     # ceiling (Σf_i/ws / max-balanced = 1.5x here) the paper's multi-GPU
     # setting allows. See artifacts/AB_ANALYSIS.md.
     ws = int(partial.get("world_size") or 4)
-    factors = [3.0] + [1.0] * (ws - 1)
+    # read the factors the injector actually ran with (persisted by
+    # run_arms); the fallback only serves legacy partials
+    factors = [float(f) for f in partial.get("straggler_factors") or []]
+    if len(factors) != ws:
+        factors = [3.0] + [1.0] * (ws - 1)
     uniform_cost = sum(factors) / ws
     eq_cost = ws / sum(1.0 / f for f in factors)
     detail = {

@@ -49,21 +49,42 @@ def arm_stall_watchdog(
     extra_paths: tuple = (),
     exit_code: int = 19,
     poll_s: float = 15.0,
+    first_grace_s: float | None = None,
 ) -> threading.Thread:
     """Arm a daemon thread that ``os._exit(exit_code)``s this process when
     ``hb_path`` (and every path in ``extra_paths``) has not been touched for
     ``stall_s`` seconds. Sets ``DBS_HEARTBEAT_FILE`` so in-process
     :func:`heartbeat` calls (and those of any child sharing the env) land on
-    ``hb_path``. Returns the thread (daemon; dies with the process)."""
+    ``hb_path``. Returns the thread (daemon; dies with the process).
+
+    ``first_grace_s``: stall threshold applied until the FIRST heartbeat
+    lands after arming. Heartbeats fire when control returns from the
+    device, and the very first unit of work includes the cold XLA compile —
+    which through the tunnel can legitimately exceed ``stall_s`` (observed:
+    the packed DenseNet epoch-0 compile ran past the 900s default and a
+    healthy run was killed, wasting the compile AND re-paying it on retry,
+    since a killed compile writes nothing to the persistent cache — a
+    compile slower than ``stall_s`` would dead-loop every retry). Default:
+    ``DBS_WATCHDOG_FIRST_GRACE_S`` env, else 1800s, floored at ``stall_s``.
+    Once any heartbeat arrives the tight ``stall_s`` applies."""
     os.environ[_ENV] = hb_path
+    if first_grace_s is None:
+        first_grace_s = float(os.environ.get("DBS_WATCHDOG_FIRST_GRACE_S", 1800))
+    first_grace_s = max(float(first_grace_s), float(stall_s))
     armed_at = time.time()
+    hb_baseline: float | None = None
     try:
         parent = os.path.dirname(hb_path)
         if parent:
             os.makedirs(parent, exist_ok=True)
         with open(hb_path, "a"):
             pass
-        os.utime(hb_path, None)
+        # backdate the arm-time touch so a real heartbeat strictly advances
+        # the mtime even on filesystems with coarse (1-2s) granularity;
+        # staleness itself is governed by max(armed_at, mtimes), which the
+        # backdating cannot lower
+        os.utime(hb_path, (armed_at - 10.0, armed_at - 10.0))
+        hb_baseline = os.path.getmtime(hb_path)
     except OSError:
         pass
 
@@ -80,12 +101,29 @@ def arm_stall_watchdog(
         return newest
 
     def _watch() -> None:
+        # cold-start grace: until the heartbeat file itself has been touched
+        # after arming (i.e. the device has answered once), allow the longer
+        # first_grace_s — the first unit of work carries the cold compile,
+        # which is slow but healthy. Keyed to hb_path's mtime advancing past
+        # the arm-time touch: extra_paths get administrative writes (e.g.
+        # the bench's initial incremental-result dump) before any device
+        # work, which must not end the grace. If the hb file could not be
+        # created at all, heartbeats can never land, so the grace could
+        # never end — skip it entirely (fail closed at the tight stall_s).
+        grace_active = hb_baseline is not None
         while True:
             time.sleep(poll_s)
+            if grace_active:
+                try:
+                    if os.path.getmtime(hb_path) > hb_baseline:
+                        grace_active = False
+                except OSError:
+                    pass
             last = _newest_mtime()
-            if time.time() - last > stall_s:
+            threshold = first_grace_s if grace_active else stall_s
+            if time.time() - last > threshold:
                 sys.stderr.write(
-                    f"[watchdog] no heartbeat for {stall_s:.0f}s "
+                    f"[watchdog] no heartbeat for {threshold:.0f}s "
                     f"(device RPC hang?); aborting\n"
                 )
                 sys.stderr.flush()

@@ -42,7 +42,18 @@ from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import (
 )
 mode = sys.argv[1]
 hb = sys.argv[2]
-arm_stall_watchdog(hb, stall_s=1.0, poll_s=0.2, exit_code=19)
+if mode == "grace":
+    # tight stall but a long first-heartbeat grace: the silent cold-compile
+    # window must survive, and the tight threshold must apply after the
+    # first heartbeat lands
+    arm_stall_watchdog(hb, stall_s=0.6, poll_s=0.1, exit_code=19,
+                       first_grace_s=6.0)
+    time.sleep(2.0)   # > stall_s, inside grace -> must survive
+    heartbeat()       # device answered once: grace over
+    time.sleep(30)    # > stall_s with no heartbeat -> must fire now
+    sys.exit(0)
+arm_stall_watchdog(hb, stall_s=1.0, poll_s=0.2, exit_code=19,
+                   first_grace_s=1.0)
 if mode == "alive":
     for _ in range(10):
         time.sleep(0.3)
@@ -69,6 +80,22 @@ def test_stale_heartbeat_hard_exits(tmp_path):
 def test_fresh_heartbeat_keeps_process_alive(tmp_path):
     proc = _run_child("alive", str(tmp_path / "hb"))
     assert proc.returncode == 0
+
+
+def test_first_grace_survives_cold_compile_then_tightens(tmp_path):
+    # silent pre-first-heartbeat window longer than stall_s survives (cold
+    # XLA compile through the tunnel); after the first heartbeat the tight
+    # stall applies and a stale heartbeat fires. Timing discriminates the
+    # regressions: tight firing lands at ~2.0+0.6s; a grace threshold that
+    # never tightens would fire at 2.0+6.0=8s, past the 5.5s bound.
+    import time
+
+    t0 = time.time()
+    proc = _run_child("grace", str(tmp_path / "hb"))
+    elapsed = time.time() - t0
+    assert proc.returncode == 19
+    assert elapsed < 5.5, f"fired at {elapsed:.1f}s: grace never tightened"
+    assert elapsed > 1.9, f"fired at {elapsed:.1f}s: grace did not hold"
 
 
 def test_fails_closed_when_hb_uncreatable(tmp_path):

@@ -1,0 +1,151 @@
+"""Cross-invocation bench partial resume (bench.py::_try_arms).
+
+A tunnel window long enough for one A/B arm but not both must not force the
+next window (a FRESH bench.py invocation, e.g. the queue's retry) to re-run
+the finished arm. _try_arms promotes completed-arm partials to a stable
+path and seeds resume from it on the next call; these tests pin that flow
+with a scripted child standing in for the arms subprocess.
+"""
+
+import json
+import os
+import sys
+import types
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def _partial(n_train, off_epochs, on_epochs, saved_at=None):
+    import time
+
+    p = {
+        "backend": "tpu",
+        "n_train": n_train,
+        "model": "densenet",
+        "world_size": 4,
+        "straggler_factors": [3.0, 1.0, 1.0, 1.0],
+        "off": [10.0 + i for i in range(off_epochs)],
+        "on": [9.0 + i for i in range(on_epochs)],
+        "instr": {
+            "off_injection_calibrated": True,
+            "on_injection_calibrated": True,
+        },
+    }
+    if saved_at is not None:
+        p["saved_at"] = saved_at if saved_at > 0 else time.time()
+    return p
+
+
+@pytest.fixture()
+def stable_path(tmp_path, monkeypatch):
+    p = tmp_path / "partial.json"
+    monkeypatch.setenv("BENCH_PARTIAL_PATH", str(p))
+    monkeypatch.setenv("BENCH_NTRAIN", "12800")
+    monkeypatch.setenv("BENCH_EPOCHS", "4")
+    monkeypatch.setenv("BENCH_RETRIES", "3")
+    return p
+
+
+def _scripted_child(monkeypatch, script):
+    """Install a fake _run_child that pops behaviors off ``script``.
+
+    Each behavior is (resume_expected: bool|None, off, on, rc) — it writes a
+    partial with the given epoch counts to --out and returns rc (None = the
+    subprocess object is None, i.e. timeout).
+    """
+    calls = []
+
+    def fake(args, timeout):
+        assert "--arms" in args
+        out = args[args.index("--out") + 1]
+        resume = (
+            args[args.index("--resume") + 1] if "--resume" in args else None
+        )
+        resume_expected, off, on, rc = script.pop(0)
+        if resume_expected is not None:
+            assert (resume is not None) == resume_expected, (
+                f"resume flag mismatch: got {resume!r}"
+            )
+        n_train = int(os.environ.get("BENCH_NTRAIN", 12800))
+        with open(out, "w") as f:
+            json.dump(_partial(n_train, off, on), f)
+        calls.append({"args": args, "n_train": n_train})
+        if rc is None:
+            return None
+        return types.SimpleNamespace(returncode=rc, stderr="")
+
+    monkeypatch.setattr(bench, "_run_child", fake)
+    monkeypatch.setattr(bench, "_wait_healthy", lambda deadline: True)
+    return calls
+
+
+def test_partial_persists_across_invocations(stable_path, monkeypatch):
+    import time
+
+    # window 1: off arm completes (3 epochs = epochs-1), then the tunnel
+    # dies -> rc 19, retries exhausted by deadline
+    _scripted_child(monkeypatch, [(False, 3, 0, 19)])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is None
+    saved = json.loads(stable_path.read_text())
+    assert len(saved["off"]) == 3  # the completed arm survived the process
+
+    # window 2: a fresh invocation must pass --resume <stable> and, on
+    # success, clean the stable file up
+    _scripted_child(monkeypatch, [(True, 3, 4, 0)])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is not None
+    assert res["vs_baseline"] > 0
+    assert not stable_path.exists()
+
+
+def test_incompatible_stable_partial_is_ignored_and_deleted(
+    stable_path, monkeypatch
+):
+    import time
+
+    # a file at an n_train not on this invocation's shrink ladder must not
+    # be offered for resume — and must be deleted so it can never pair
+    # old-session timings with a later matching config
+    stable_path.write_text(json.dumps(_partial(1777, 3, 0, saved_at=-1)))
+    _scripted_child(monkeypatch, [(False, 3, 4, 0)])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is not None
+    assert not stable_path.exists()
+
+
+def test_unstamped_stable_partial_is_rejected(stable_path, monkeypatch):
+    import time
+
+    # no saved_at stamp -> age unknown -> treated as expired
+    stable_path.write_text(json.dumps(_partial(12800, 3, 0)))
+    _scripted_child(monkeypatch, [(False, 3, 4, 0)])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is not None
+    assert not stable_path.exists()
+
+
+def test_shrunken_partial_resumes_at_its_n_train(stable_path, monkeypatch):
+    import time
+
+    # window 1 shrank once (12800 -> 6400) and completed the off arm there;
+    # window 2 must seed shrink=1 and resume at 6400, not reject the file
+    stable_path.write_text(json.dumps(_partial(6400, 3, 0, saved_at=-1)))
+    calls = _scripted_child(monkeypatch, [(True, 3, 4, 0)])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=3)
+    assert res is not None
+    assert calls[0]["n_train"] == 6400
+    assert not stable_path.exists()
+
+
+def test_no_arm_completed_leaves_no_stable_file(stable_path, monkeypatch):
+    import time
+
+    _scripted_child(monkeypatch, [(False, 1, 0, 19)])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is None
+    assert not stable_path.exists()

@@ -35,6 +35,9 @@ if os.environ.get("STATIS_CPU") == "1":
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# persistent XLA compile cache: a tunnel-drop retry must not re-pay compiles
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "./.jax_cache")
+
 NTRAIN = int(os.environ.get("STATIS_NTRAIN", 4096))
 LM_NTRAIN = int(os.environ.get("STATIS_LM_NTRAIN", 120_000))
 EPOCHS = int(os.environ.get("STATIS_EPOCHS", 6))

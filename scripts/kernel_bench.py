@@ -24,6 +24,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# persistent XLA compile cache: a tunnel-drop retry must not re-pay compiles
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "./.jax_cache")
+
 import jax
 import jax.numpy as jnp
 

@@ -17,6 +17,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# persistent XLA compile cache: a tunnel-drop retry must not re-pay compiles
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "./.jax_cache")
+
 
 def run_leg(precision: str, n_train: int, epochs: int, model: str):
     from dynamic_load_balance_distributeddnn_tpu.config import Config

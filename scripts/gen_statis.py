@@ -115,14 +115,31 @@ def main() -> int:
     # already run elastic (compute-mode probes force it) and keep their
     # default topology. CPU-insurance only; TPU runs skip this env var.
     force_elastic = os.environ.get("STATIS_FORCE_ELASTIC") == "1"
-    manifest = {
-        "platform": jax.devices()[0].platform,
-        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
-        "ntrain": NTRAIN,
-        "lm_ntrain": LM_NTRAIN,
-        "epochs": EPOCHS,
-        "runs": {},
-    }
+    platform = jax.devices()[0].platform
+    device_kind = getattr(jax.devices()[0], "device_kind", "?")
+    # merge with any existing manifest: the queue fills this dir across
+    # several invocations (c1/c5 on the CPU tier, c2-c4 on chip, retries
+    # after tunnel drops) and each run's provenance must survive them all
+    mpath = os.path.join(ns.out_dir, "manifest.json")
+    manifest = {}
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        pass
+    # platform the PREVIOUS invocation ran on: legacy manifests carry it only
+    # at top level, newer ones per run entry
+    legacy_platform = manifest.get("platform")
+    manifest.update(
+        {
+            "platform": platform,
+            "device_kind": device_kind,
+            "ntrain": NTRAIN,
+            "lm_ntrain": LM_NTRAIN,
+            "epochs": EPOCHS,
+        }
+    )
+    manifest.setdefault("runs", {})
     for name in names:
         base = list(CONFIGS[name])
         if vision_b and name != "c5_transformer":
@@ -155,15 +172,52 @@ def main() -> int:
                 "--stat_dir", stat_dir,
                 "--log_dir", log_dir,
             ]
+            from dynamic_load_balance_distributeddnn_tpu.config import (
+                config_from_args,
+            )
+            from dynamic_load_balance_distributeddnn_tpu.obs.logging import (
+                _done_sentinel,
+                run_already_done,
+            )
+
+            cfg = config_from_args(args)
+            key = f"{name}_dbs{dbs}"
+            # chip runs supersede CPU-tier runs in the same out_dir (never
+            # the reverse): if this arm's sentinel was written by a non-TPU
+            # invocation and we are ON the chip now, clear it so the run
+            # re-executes here instead of being skipped by the reference
+            # idempotence probe
+            if platform == "tpu":
+                prev_run = manifest["runs"].get(key) or {}
+                prev_platform = prev_run.get("platform") or legacy_platform
+                if prev_platform and prev_platform != "tpu":
+                    sentinel = _done_sentinel(cfg)
+                    if os.path.isfile(sentinel):
+                        os.unlink(sentinel)
+                        print(
+                            f"[gen_statis] {name} dbs={dbs}: clearing "
+                            f"{prev_platform} sentinel, re-running on tpu",
+                            flush=True,
+                        )
+            skipped = run_already_done(cfg)
             t0 = time.time()
             print(f"[gen_statis] {name} dbs={dbs}: cli.main({' '.join(args)})", flush=True)
             rc = cli.main(args)
-            manifest["runs"][f"{name}_dbs{dbs}"] = {
-                "rc": rc,
-                "wall_s": round(time.time() - t0, 1),
-                "args": args,
-            }
-            with open(os.path.join(ns.out_dir, "manifest.json"), "w") as f:
+            if skipped and key in manifest["runs"]:
+                # sentinel skip: the run that produced the artifacts is the
+                # recorded one — keep its provenance, don't clobber wall_s
+                # and platform with the skip's
+                pass
+            else:
+                manifest["runs"][key] = {
+                    "rc": rc,
+                    "wall_s": round(time.time() - t0, 1),
+                    "platform": platform,
+                    "device_kind": device_kind,
+                    "args": args,
+                    **({"sentinel_skip": True} if skipped else {}),
+                }
+            with open(mpath, "w") as f:
                 json.dump(manifest, f, indent=2)
             if rc != 0:
                 print(f"[gen_statis] {name} dbs={dbs} FAILED rc={rc}", file=sys.stderr)

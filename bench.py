@@ -367,6 +367,12 @@ def _result_from(partial) -> dict | None:
     detail = {
         "backend": partial.get("backend"),
         "model": partial.get("model"),
+        # a resumed arm was measured when its partial was SAVED, not when the
+        # result was finally assembled — stamp the older of the two so the
+        # cache TTL bounds true measurement age
+        "measured_at_unix": round(
+            min(time.time(), float(partial.get("saved_at") or time.time())), 1
+        ),
         "serialized_chip_ceiling": round(uniform_cost / eq_cost, 4),
         "dbs_off_epochs_s": partial.get("off"),
         "dbs_on_epochs_s": partial.get("on"),
@@ -566,6 +572,40 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
     return best
 
 
+def _cached_tpu_result() -> dict | None:
+    """Last successful ON-CHIP result, for when the tunnel is down at
+    invocation time (it comes and goes for hours here). A real measured
+    number from this round beats re-measuring on the CPU fallback — the
+    result is clearly labeled cached (cached_result/cached_from/
+    cached_age_s in detail) and age-bounded so a previous round's artifact
+    can never masquerade as current."""
+    path = os.environ.get(
+        "BENCH_CACHE_PATH", os.path.join("artifacts", "BENCH_local_tpu.json")
+    )
+    ttl = float(os.environ.get("BENCH_CACHE_TTL_S", 48 * 3600))
+    try:
+        with open(path) as f:
+            res = json.load(f)
+        if res.get("detail", {}).get("backend") != "tpu":
+            return None
+        # the timestamp must come from INSIDE the artifact: git checkout
+        # refreshes file mtimes, which would let a PREVIOUS round's
+        # committed artifact (measured on old code) pass an mtime TTL.
+        # Legacy artifacts without the stamp are rejected outright.
+        ts = res["detail"].get("measured_at_unix")
+        if not ts:
+            return None
+        age = time.time() - float(ts)
+        if age > ttl or age < -60:
+            return None
+        res["detail"]["cached_result"] = True
+        res["detail"]["cached_from"] = path
+        res["detail"]["cached_age_s"] = round(age, 1)
+        return res
+    except (OSError, ValueError, TypeError, AttributeError):
+        return None
+
+
 def main() -> int:
     global _best_result
     if "--preflight" in sys.argv:
@@ -618,7 +658,14 @@ def main() -> int:
             break
         rc = "timeout" if proc is None else proc.returncode
         sys.stderr.write(f"[bench] preflight failed (rc={rc})\n")
-        if i == 0 and insurance_on and _best_result is None:
+        if (
+            i == 0
+            and insurance_on
+            and _best_result is None
+            and _cached_tpu_result() is None
+        ):
+            # no cached on-chip result to fall back on — only then is the
+            # insurance run worth its wall-clock
             sys.stderr.write("[bench] running CPU insurance arms\n")
             _best_result = _try_arms(
                 force_cpu=True,
@@ -632,6 +679,15 @@ def main() -> int:
         res = _try_arms(force_cpu=False, deadline=deadline, retries=retries)
         if res is not None:
             _best_result = res  # a TPU number beats any insurance
+    if _best_result is None or _best_result.get("detail", {}).get("backend") != "tpu":
+        cached = _cached_tpu_result()
+        if cached is not None:
+            sys.stderr.write(
+                "[bench] tunnel unavailable for a live run; emitting the "
+                f"cached on-chip result ({cached['detail']['cached_age_s']:.0f}s old, "
+                f"{cached['detail']['cached_from']})\n"
+            )
+            _best_result = cached
     if _best_result is None and insurance_on:
         _best_result = _try_arms(
             force_cpu=True, deadline=max(deadline, time.time() + 900), retries=1

@@ -16,8 +16,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "[queue] === full bench ==="
     mkdir -p artifacts
     BENCH_TOTAL_BUDGET=${BENCH_TOTAL_BUDGET:-5400} timeout 6000 python bench.py \
-      > artifacts/BENCH_local_tpu.json 2>/tmp/bench_full.log \
+      > artifacts/BENCH_local_tpu.json.tmp 2>/tmp/bench_full.log \
       || echo "[queue] bench failed rc=$?"
+    grep -q '"backend": "tpu"' artifacts/BENCH_local_tpu.json.tmp 2>/dev/null \
+      && mv artifacts/BENCH_local_tpu.json.tmp artifacts/BENCH_local_tpu.json
     echo "[queue] bench result: $(cat artifacts/BENCH_local_tpu.json 2>/dev/null | head -c 400)"
     echo "[queue] === acceptance statis (heavy CNN configs) ==="
     STATIS_ONLY=c2_resnet18,c3_densenet,c4_regnet_ws8 STATIS_WARM=true \

@@ -17,8 +17,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "[queue2] === full bench (device cache) ==="
     mkdir -p artifacts
     BENCH_TOTAL_BUDGET=${BENCH_TOTAL_BUDGET:-5400} timeout 6000 python bench.py \
-      > artifacts/BENCH_local_tpu.json 2>/tmp/bench_full2.log \
+      > artifacts/BENCH_local_tpu.json.tmp 2>/tmp/bench_full2.log \
       || echo "[queue2] bench failed rc=$?"
+    grep -q '"backend": "tpu"' artifacts/BENCH_local_tpu.json.tmp 2>/dev/null \
+      && mv artifacts/BENCH_local_tpu.json.tmp artifacts/BENCH_local_tpu.json
     echo "[queue2] bench result: $(head -c 400 artifacts/BENCH_local_tpu.json 2>/dev/null)"
     echo "[queue2] === flash TPU test ==="
     RUN_TPU_TESTS=1 timeout 1500 python -m pytest \

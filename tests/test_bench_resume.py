@@ -149,3 +149,47 @@ def test_no_arm_completed_leaves_no_stable_file(stable_path, monkeypatch):
     res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
     assert res is None
     assert not stable_path.exists()
+
+
+def _cached_artifact(tmp_path, monkeypatch, *, backend="tpu", ts=None):
+    path = tmp_path / "BENCH_local_tpu.json"
+    res = {
+        "metric": "densenet121_cifar10_ws4_3to1straggler_epoch_wallclock",
+        "value": 2.0,
+        "unit": "s",
+        "vs_baseline": 1.2,
+        "detail": {"backend": backend},
+    }
+    if ts is not None:
+        res["detail"]["measured_at_unix"] = ts
+    path.write_text(json.dumps(res))
+    monkeypatch.setenv("BENCH_CACHE_PATH", str(path))
+    return path
+
+
+def test_cached_tpu_result_accepted_when_fresh(tmp_path, monkeypatch):
+    import time
+
+    _cached_artifact(tmp_path, monkeypatch, ts=time.time() - 3600)
+    res = bench._cached_tpu_result()
+    assert res is not None
+    assert res["detail"]["cached_result"] is True
+    assert res["detail"]["cached_age_s"] == pytest.approx(3600, abs=60)
+
+
+def test_cached_tpu_result_rejects_unstamped_legacy(tmp_path, monkeypatch):
+    # a previous round's committed artifact: checkout refreshes its mtime,
+    # but it carries no measured_at_unix -> must be rejected
+    _cached_artifact(tmp_path, monkeypatch, ts=None)
+    assert bench._cached_tpu_result() is None
+
+
+def test_cached_tpu_result_rejects_expired_and_nontpu(tmp_path, monkeypatch):
+    import time
+
+    _cached_artifact(tmp_path, monkeypatch, ts=time.time() - 3 * 86400)
+    assert bench._cached_tpu_result() is None
+    _cached_artifact(
+        tmp_path, monkeypatch, backend="cpu_fallback", ts=time.time()
+    )
+    assert bench._cached_tpu_result() is None

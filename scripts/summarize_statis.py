@@ -57,6 +57,12 @@ def fmt_run(name, d):
 
 
 def main(argv):
+    md_out = None
+    argv = list(argv or [])
+    if "--markdown" in argv:
+        i = argv.index("--markdown")
+        md_out = argv[i + 1]
+        del argv[i : i + 2]
     paths = []
     for a in argv or ["./statis"]:
         if os.path.isdir(a):
@@ -75,6 +81,7 @@ def main(argv):
         print(fmt_run(name, d))
         print()
     # A/B headline per config: pair -dbs1- with -dbs0-
+    ab_rows = []
     for name, d in runs.items():
         if "-dbs1-" not in name:
             continue
@@ -90,6 +97,18 @@ def main(argv):
         off_win = off_w[1:] if len(off_w) > 1 else off_w[-1:]
         on_med, off_med = float(np.median(on_win)), float(np.median(off_win))
         on_min, off_min = float(np.min(on_win)), float(np.min(off_win))
+        ab_rows.append(
+            {
+                "config": name.split("-node")[0],
+                "on_median_s": on_med,
+                "off_median_s": off_med,
+                "speedup_median": off_med / max(on_med, 1e-9),
+                "speedup_min": off_min / max(on_min, 1e-9),
+                "acc_on": float(d["accuracy"][-1]),
+                "acc_off": float(off["accuracy"][-1]),
+                "synthetic": bool((d.get("_meta") or {}).get("synthetic")),
+            }
+        )
         print(
             f"A/B {name.split('-node')[0]}: steady epoch "
             f"on={on_med:.3f}s off={off_med:.3f}s "
@@ -97,6 +116,29 @@ def main(argv):
             f"speedup(min)={off_min / max(on_min, 1e-9):.2f}x "
             f"acc on/off={d['accuracy'][-1]:.2f}/{off['accuracy'][-1]:.2f}"
         )
+    if md_out and ab_rows:
+        lines = [
+            "# Acceptance A/B table",
+            "",
+            "Steady-state epoch wall-clock, dbs on vs off (median over the "
+            "steady window, min alongside; reference protocol BASELINE.md).",
+            "",
+            "| config | on median (s) | off median (s) | speedup (median) | "
+            "speedup (min) | acc on/off |",
+            "|---|---|---|---|---|---|",
+        ]
+        for r in sorted(ab_rows, key=lambda r: r["config"]):
+            acc = f"{r['acc_on']:.2f}/{r['acc_off']:.2f}"
+            if r["synthetic"]:
+                acc += " (synthetic)"
+            lines.append(
+                f"| {r['config']} | {r['on_median_s']:.3f} | "
+                f"{r['off_median_s']:.3f} | {r['speedup_median']:.2f}x | "
+                f"{r['speedup_min']:.2f}x | {acc} |"
+            )
+        with open(md_out, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"[summarize_statis] wrote {md_out}")
     return 0
 
 

@@ -176,6 +176,14 @@ class Trainer:
         self.recorder.stamp_data_source(
             self.bundle if self.bundle is not None else getattr(self, "corpus", None)
         )
+        # induced-straggler provenance: lets offline tooling compute the
+        # ideal equilibrium partition (share_i ∝ 1/f_i) and report the
+        # balancer-quality convergence metric (BASELINE.md §protocol)
+        if cfg.straggler:
+            self.recorder.meta["straggler_factors"] = [
+                float(f) for f in cfg.straggler_factors()
+            ]
+            self.recorder.meta["fault_mode"] = cfg.fault_mode
         self.shares = initial_partition(cfg.world_size)
         self.node_times = np.ones(cfg.world_size, dtype=np.float64)
         self.per_example_cost = np.full(cfg.world_size, np.nan)

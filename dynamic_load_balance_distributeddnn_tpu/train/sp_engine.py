@@ -120,6 +120,11 @@ class SeqParallelLMTrainer:
         self._update = update
         self.recorder = MetricsRecorder()
         self.recorder.stamp_data_source(self.corpus)
+        if cfg.straggler:
+            self.recorder.meta["straggler_factors"] = [
+                float(f) for f in cfg.straggler_factors()
+            ]
+            self.recorder.meta["fault_mode"] = cfg.fault_mode
         self.total_wallclock = 0.0
 
     # ------------------------------------------------------------------ loop

@@ -97,6 +97,16 @@ def main(argv):
         off_win = off_w[1:] if len(off_w) > 1 else off_w[-1:]
         on_med, off_med = float(np.median(on_win)), float(np.median(off_win))
         on_min, off_min = float(np.min(on_win)), float(np.min(off_win))
+        # balancer-quality metric (BASELINE.md §protocol): distance of the
+        # final partition from the ideal equilibrium share_i ∝ 1/f_i, when
+        # the artifact records its induced straggler profile
+        conv = None
+        factors = (d.get("_meta") or {}).get("straggler_factors")
+        if factors:
+            inv = 1.0 / np.asarray(factors, dtype=float)
+            ideal = inv / inv.sum()
+            final = np.asarray(d["partition"][-1], dtype=float)
+            conv = float(np.abs(final - ideal).max())
         ab_rows.append(
             {
                 "config": name.split("-node")[0],
@@ -107,6 +117,7 @@ def main(argv):
                 "acc_on": float(d["accuracy"][-1]),
                 "acc_off": float(off["accuracy"][-1]),
                 "synthetic": bool((d.get("_meta") or {}).get("synthetic")),
+                "partition_err": conv,
             }
         )
         print(
@@ -124,17 +135,22 @@ def main(argv):
             "steady window, min alongside; reference protocol BASELINE.md).",
             "",
             "| config | on median (s) | off median (s) | speedup (median) | "
-            "speedup (min) | acc on/off |",
-            "|---|---|---|---|---|---|",
+            "speedup (min) | acc on/off | partition err |",
+            "|---|---|---|---|---|---|---|",
         ]
         for r in sorted(ab_rows, key=lambda r: r["config"]):
             acc = f"{r['acc_on']:.2f}/{r['acc_off']:.2f}"
             if r["synthetic"]:
                 acc += " (synthetic)"
+            perr = (
+                f"{r['partition_err']:.3f}"
+                if r["partition_err"] is not None
+                else "—"
+            )
             lines.append(
                 f"| {r['config']} | {r['on_median_s']:.3f} | "
                 f"{r['off_median_s']:.3f} | {r['speedup_median']:.2f}x | "
-                f"{r['speedup_min']:.2f}x | {acc} |"
+                f"{r['speedup_min']:.2f}x | {acc} | {perr} |"
             )
         with open(md_out, "w") as f:
             f.write("\n".join(lines) + "\n")

@@ -48,7 +48,7 @@ while true; do
     echo "[queue3] all legs complete at $(date -u +%H:%M:%S)"
     exit 0
   fi
-  if PROBE_CAP_S=300 timeout 320 python scripts/tpu_probe_once.py 2>&1 | grep -q "PROBE ok"; then
+  if PROBE_CAP_S="${TPU_PROBE_CAP_S:-300}" timeout "$(( ${TPU_PROBE_CAP_S:-300} + 20 ))" python scripts/tpu_probe_once.py 2>&1 | grep -q "PROBE ok"; then
     echo "[queue3] TPU up at $(date -u +%H:%M:%S)"
     # a failed leg usually means the tunnel dropped mid-run — go straight
     # back to the probe loop instead of burning every later leg's timeout
@@ -74,8 +74,8 @@ while true; do
       sh -c 'python scripts/gen_statis.py --out_dir artifacts/acceptance >> /tmp/gen_statis_tpu.log 2>&1' \
       || continue
   else
-    echo "[queue3] TPU down at $(date -u +%H:%M:%S); sleeping 120s"
-    sleep 120
+    echo "[queue3] TPU down at $(date -u +%H:%M:%S); sleeping ${TPU_PROBE_SLEEP_S:-120}s"
+    sleep "${TPU_PROBE_SLEEP_S:-120}"
   fi
   sleep 5
 done

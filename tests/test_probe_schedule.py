@@ -169,3 +169,15 @@ def test_adaptive_skips_with_compute_injection(bundle):
     for e in range(5):
         tr.run_epoch(e)
     assert not {2, 3} & set(calls), f"rebalance misread as episode change: {calls}"
+
+
+def test_straggler_profile_stamped_in_meta(bundle):
+    # the induced profile is recorded so offline tooling can compute the
+    # ideal equilibrium partition (BASELINE.md balancer-quality metric)
+    tr = Trainer(
+        _cfg(straggler="3,1,1,1", fault_mode="virtual"),
+        bundle=bundle,
+        log_to_file=False,
+    )
+    assert tr.recorder.meta["straggler_factors"] == [3.0, 1.0, 1.0, 1.0]
+    assert tr.recorder.meta["fault_mode"] == "virtual"

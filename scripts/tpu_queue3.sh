@@ -21,6 +21,7 @@ trap 'rm -f .tpu_busy' EXIT
 leg () {  # leg <name> <timeout_s> <cmd...>
   local name="$1" tmo="$2"; shift 2
   [ -f "$STAMPS/$name.done" ] && return 0
+  [ -f "$STAMPS/$name.gaveup" ] && return 0
   echo "[queue3] === leg $name ($(date -u +%H:%M:%S)) ==="
   touch .tpu_busy
   if timeout "$tmo" "$@"; then
@@ -32,13 +33,26 @@ leg () {  # leg <name> <timeout_s> <cmd...>
     local rc=$?
     echo "[queue3] leg $name failed rc=$rc"
     rm -f .tpu_busy
+    # tunnel still up right after the failure => the failure is REAL, not a
+    # drop. Bound real failures (3 attempts) so one broken leg cannot
+    # starve everything queued behind it; a drop keeps unlimited retries.
+    if PROBE_CAP_S=60 timeout 80 python scripts/tpu_probe_once.py 2>&1 | grep -q "PROBE ok"; then
+      local n=0
+      [ -f "$STAMPS/$name.attempts" ] && n=$(cat "$STAMPS/$name.attempts")
+      n=$((n + 1)); echo "$n" > "$STAMPS/$name.attempts"
+      if [ "$n" -ge 3 ]; then
+        echo "[queue3] leg $name failed $n times with the tunnel up; skipping it"
+        touch "$STAMPS/$name.gaveup"
+        return 0
+      fi
+    fi
     return "$rc"
   fi
 }
 
 all_done () {
   for n in bench mfu flash kernels statis precision statis_c5; do
-    [ -f "$STAMPS/$n.done" ] || return 1
+    [ -f "$STAMPS/$n.done" ] || [ -f "$STAMPS/$n.gaveup" ] || return 1
   done
   return 0
 }

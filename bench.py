@@ -208,6 +208,19 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
             for k, v in resume.get("instr", {}).items():
                 if k.startswith(arm + "_"):
                     out["instr"][k] = v
+            # a resumed arm's timings are as old as the partial they came
+            # from — carry its save stamp so measured_at_unix (and every TTL
+            # built on it) bounds the TRUE measurement age, not assembly time.
+            # The stamp is kept PER ARM as well, so a later strip of one arm
+            # can recompute the file-level stamp from the survivors.
+            src_ts = (resume.get("arm_saved_at") or {}).get(arm) or resume.get(
+                "saved_at"
+            )
+            if src_ts:
+                out.setdefault("arm_saved_at", {})[arm] = float(src_ts)
+                out["saved_at"] = min(
+                    float(out.get("saved_at") or src_ts), float(src_ts)
+                )
             _write_atomic(out_path, out)
             sys.stderr.write(f"[bench] arm {arm} resumed from previous attempt\n")
             continue
@@ -247,6 +260,9 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
             wall = tr.run_epoch(e)["epoch_wall"]
             out[arm].append(round(wall, 4))
             _write_atomic(out_path, out)
+        # stamp the freshly measured arm so later windows never mis-attribute
+        # a resumed sibling's (older) file-level stamp to it
+        out.setdefault("arm_saved_at", {})[arm] = time.time()
         for k in ("examples_per_s", "mfu_bf16_peak", "accuracy"):
             if tr.recorder.data.get(k):
                 out["instr"][f"{arm}_{k}"] = tr.recorder.data[k][-1]
@@ -515,6 +531,57 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
         except Exception:
             partial = {}
         res = _result_from(partial)
+        calib_rejected = False
+        if res is None:
+            # No result from this attempt: promoting a known-rejected arm
+            # would make every later invocation resume — and re-reject — it
+            # for the whole partial TTL, pinning bench to no-result (or
+            # burning a full window measuring its sibling first). Strip
+            # completed-but-uncalibrated arms REGARDLESS of how the attempt
+            # ended; additionally, an rc==0 run whose rejection is not
+            # attributable to an arm is dropped wholesale.
+            instr = partial.get("instr", {})
+            poisoned = [
+                a
+                for a in ("off", "on")
+                if instr.get(f"{a}_injection_calibrated") is False
+                and len(partial.get(a, [])) >= arm_needs[a]
+            ]
+            all_complete = all(
+                len(partial.get(a, [])) >= n for a, n in arm_needs.items()
+            )
+            clean_exit = proc is not None and proc.returncode == 0
+            if poisoned or (all_complete and clean_exit):
+                calib_rejected = True
+                for a in poisoned:
+                    partial.pop(a, None)
+                    (partial.get("arm_saved_at") or {}).pop(a, None)
+                    for k in [k for k in list(instr) if k.startswith(a + "_")]:
+                        instr.pop(k)
+                if not poisoned:
+                    partial = {}
+                # the file-level stamp may have belonged to a stripped arm;
+                # recompute it from the surviving resumed arms so a fresh
+                # survivor is not promoted pre-aged (it would expire the
+                # partial TTL and the result cache early)
+                arm_ts = list((partial.get("arm_saved_at") or {}).values())
+                if arm_ts:
+                    partial["saved_at"] = min(arm_ts)
+                else:
+                    partial.pop("saved_at", None)
+                # persist the strip: the on-disk out_path still holds the
+                # rejected arms, and the promotion-FAILURE fallback below
+                # resumes from out_path — it must not see them either
+                _write_atomic(out_path, partial)
+                # the file that seeded this attempt holds the rejected arms;
+                # drop it so nothing can resume them verbatim (a surviving
+                # good arm is re-promoted just below)
+                if resume_path:
+                    try:
+                        os.unlink(resume_path)
+                    except OSError:
+                        pass
+                    resume_path = ""
         if res is not None:
             quality = (
                 len(partial.get("off", [])) + len(partial.get("on", [])),
@@ -543,7 +610,12 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
             try:
                 os.makedirs(os.path.dirname(stable_partial) or ".", exist_ok=True)
                 stamped = dict(partial)
-                stamped["saved_at"] = time.time()
+                # never re-stamp forward: a partial resumed across windows
+                # keeps the save time of its OLDEST constituent arm, so the
+                # partial TTL and measured_at_unix bound true age
+                stamped["saved_at"] = min(
+                    float(partial.get("saved_at") or time.time()), time.time()
+                )
                 _write_atomic(stable_partial, stamped)
                 if out_path != stable_partial:
                     os.unlink(out_path)
@@ -555,10 +627,12 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
                 os.unlink(out_path)
             except OSError:
                 pass
-            if not resume_path:
+            if not resume_path and not calib_rejected:
                 # nothing salvageable anywhere — next attempt runs smaller.
                 # (Never shrink while a resumable partial exists: resume
-                # requires the same n_train.)
+                # requires the same n_train. And never shrink because of a
+                # calibration rejection: the run FIT the budget — scale was
+                # not the problem.)
                 shrink += 1
         sys.stderr.write(
             f"[bench] arms(cpu={force_cpu}) attempt {attempt+1} rc={rc} "

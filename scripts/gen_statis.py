@@ -127,9 +127,6 @@ def main() -> int:
             manifest = json.load(f)
     except (OSError, ValueError):
         pass
-    # platform the PREVIOUS invocation ran on: legacy manifests carry it only
-    # at top level, newer ones per run entry
-    legacy_platform = manifest.get("platform")
     manifest.update(
         {
             "platform": platform,
@@ -182,6 +179,15 @@ def main() -> int:
 
             cfg = config_from_args(args)
             key = f"{name}_dbs{dbs}"
+            # a non-tpu (e.g. reduced-scale CPU-insurance) run must never
+            # clobber a chip entry's provenance — it runs a different config
+            # (different sentinel), so record it under its own key and leave
+            # the tpu entry (and its sentinel) standing
+            if (
+                platform != "tpu"
+                and (manifest["runs"].get(key) or {}).get("platform") == "tpu"
+            ):
+                key = f"{key}_{platform}"
             # chip runs supersede CPU-tier runs in the same out_dir (never
             # the reverse): if this arm's sentinel was written by a non-TPU
             # invocation and we are ON the chip now, clear it so the run
@@ -189,14 +195,23 @@ def main() -> int:
             # idempotence probe
             if platform == "tpu":
                 prev_run = manifest["runs"].get(key) or {}
-                prev_platform = prev_run.get("platform") or legacy_platform
-                if prev_platform and prev_platform != "tpu":
+                # only the PER-RUN platform is trustworthy: the top-level
+                # manifest platform is whatever the last invocation ran on
+                # (a CPU-tier c1 run after a TPU c3 run would misclassify the
+                # TPU sentinels and re-burn tunnel window re-running them).
+                # Anything not positively attributed to the chip — explicit
+                # cpu tier, a legacy entry with no platform field, or an
+                # unattributed sentinel skip — is superseded by running here:
+                # one idempotent re-run, after which the manifest records tpu
+                prev_platform = prev_run.get("platform")
+                if prev_platform != "tpu":
                     sentinel = _done_sentinel(cfg)
                     if os.path.isfile(sentinel):
                         os.unlink(sentinel)
                         print(
                             f"[gen_statis] {name} dbs={dbs}: clearing "
-                            f"{prev_platform} sentinel, re-running on tpu",
+                            f"{prev_platform or 'unattributed'} sentinel, "
+                            "re-running on tpu",
                             flush=True,
                         )
             skipped = run_already_done(cfg)
@@ -212,8 +227,12 @@ def main() -> int:
                 manifest["runs"][key] = {
                     "rc": rc,
                     "wall_s": round(time.time() - t0, 1),
-                    "platform": platform,
-                    "device_kind": device_kind,
+                    # a sentinel skip executed nothing here: the artifacts
+                    # came from an invocation this manifest never saw, so
+                    # their platform is unknown — recording THIS invocation's
+                    # would let a later TPU pass wrongly trust (or clear) them
+                    "platform": "unknown" if skipped else platform,
+                    "device_kind": "?" if skipped else device_kind,
                     "args": args,
                     **({"sentinel_skip": True} if skipped else {}),
                 }

@@ -61,6 +61,10 @@ def main(argv):
     argv = list(argv or [])
     if "--markdown" in argv:
         i = argv.index("--markdown")
+        if i + 1 >= len(argv):
+            print("usage: summarize_statis.py [--markdown OUT] [PATHS...]",
+                  file=sys.stderr)
+            return 2
         md_out = argv[i + 1]
         del argv[i : i + 2]
     paths = []

@@ -151,6 +151,192 @@ def test_no_arm_completed_leaves_no_stable_file(stable_path, monkeypatch):
     assert not stable_path.exists()
 
 
+def _custom_child(monkeypatch, behaviors):
+    """Like _scripted_child but each behavior is callable(out_path, resume_path)
+    -> rc, free to write any partial shape (carried saved_at, poisoned
+    calibration flags, ...)."""
+
+    def fake(args, timeout):
+        assert "--arms" in args
+        out = args[args.index("--out") + 1]
+        resume = args[args.index("--resume") + 1] if "--resume" in args else None
+        rc = behaviors.pop(0)(out, resume)
+        if rc is None:
+            return None
+        return types.SimpleNamespace(returncode=rc, stderr="")
+
+    monkeypatch.setattr(bench, "_run_child", fake)
+    monkeypatch.setattr(bench, "_wait_healthy", lambda deadline: True)
+
+
+def test_resumed_partial_keeps_measurement_age(stable_path, monkeypatch):
+    """ADVICE r3 #1: a cross-window resumed arm's timings are as old as the
+    partial they came from; measured_at_unix must reflect that save time, not
+    the final assembly time (which could be up to the partial TTL later)."""
+    import time
+
+    old_ts = time.time() - 7200.0
+    stable_path.write_text(json.dumps(_partial(12800, 3, 0, saved_at=old_ts)))
+
+    def child(out, resume):
+        # emulate run_arms: resume the off arm, carry the partial's saved_at,
+        # then run the on arm fresh
+        with open(resume) as f:
+            prev = json.load(f)
+        p = _partial(12800, 3, 4)
+        p["saved_at"] = prev["saved_at"]
+        with open(out, "w") as f:
+            json.dump(p, f)
+        return 0
+
+    _custom_child(monkeypatch, [child])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is not None
+    assert res["detail"]["measured_at_unix"] == pytest.approx(old_ts, abs=5)
+
+
+def test_promotion_preserves_oldest_saved_at(stable_path, monkeypatch):
+    """ADVICE r3 #1 (promotion leg): re-promoting a partial that carries an
+    old saved_at must keep the old stamp, not reset the age clock."""
+    import time
+
+    old_ts = time.time() - 7200.0
+
+    def child(out, resume):
+        p = _partial(12800, 3, 0)  # off arm complete, on arm lost
+        p["saved_at"] = old_ts
+        with open(out, "w") as f:
+            json.dump(p, f)
+        return 19  # tunnel died
+
+    _custom_child(monkeypatch, [child])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is None
+    saved = json.loads(stable_path.read_text())
+    assert saved["saved_at"] == pytest.approx(old_ts, abs=5)
+
+
+def test_rejected_arm_is_stripped_not_pinned(stable_path, monkeypatch):
+    """ADVICE r3 #2: a complete-but-rejected partial (on arm uncalibrated)
+    must not be promoted verbatim — every retry would resume and re-reject it
+    for the whole partial TTL. The poisoned arm is stripped; the good arm's
+    work survives."""
+    import time
+
+    def child(out, resume):
+        p = _partial(12800, 3, 4)
+        p["instr"]["on_injection_calibrated"] = False
+        with open(out, "w") as f:
+            json.dump(p, f)
+        return 0
+
+    _custom_child(monkeypatch, [child])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is None
+    saved = json.loads(stable_path.read_text())
+    assert len(saved["off"]) == 3  # calibrated arm survived
+    assert not saved.get("on")  # poisoned arm stripped
+    assert "on_injection_calibrated" not in saved.get("instr", {})
+
+
+def test_fully_rejected_partial_is_dropped(stable_path, monkeypatch):
+    """ADVICE r3 #2: when every complete arm is rejected, nothing is
+    promoted and the seeding file is deleted so later invocations start
+    clean instead of resuming the rejection."""
+    import time
+
+    stable_path.write_text(json.dumps(_partial(12800, 3, 0, saved_at=-1)))
+
+    def child(out, resume):
+        p = _partial(12800, 3, 4)
+        p["instr"]["off_injection_calibrated"] = False
+        p["instr"]["on_injection_calibrated"] = False
+        with open(out, "w") as f:
+            json.dump(p, f)
+        return 0
+
+    _custom_child(monkeypatch, [child])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is None
+    assert not stable_path.exists()
+
+
+def test_poisoned_arm_not_promoted_on_crash(stable_path, monkeypatch):
+    """A completed-but-uncalibrated arm must be stripped even when the
+    attempt ends rc!=0 (tunnel drop mid-sibling-arm) — promoting it would
+    make the next window resume it, measure the sibling, and only then
+    discover the A/B is rejected, burning the window for nothing."""
+    import time
+
+    def child(out, resume):
+        p = _partial(12800, 3, 0)  # off complete, on lost to the drop
+        p["instr"]["off_injection_calibrated"] = False
+        with open(out, "w") as f:
+            json.dump(p, f)
+        return 19
+
+    _custom_child(monkeypatch, [child])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is None
+    assert not stable_path.exists()  # nothing resumable was worth keeping
+
+
+def test_calibration_rejection_does_not_shrink(stable_path, monkeypatch):
+    """A rejected-but-complete run proves the budget was sufficient; the
+    shrink ladder (meant for budget exhaustion) must not fire on it."""
+    import time
+
+    seen_ntrain = []
+
+    def poisoned_child(out, resume):
+        seen_ntrain.append(int(os.environ["BENCH_NTRAIN"]))
+        p = _partial(int(os.environ["BENCH_NTRAIN"]), 3, 4)
+        p["instr"]["off_injection_calibrated"] = False
+        p["instr"]["on_injection_calibrated"] = False
+        with open(out, "w") as f:
+            json.dump(p, f)
+        return 0
+
+    _custom_child(monkeypatch, [poisoned_child, poisoned_child])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=2)
+    assert res is None
+    assert seen_ntrain == [12800, 12800]  # no scale downgrade
+
+
+def test_strip_resets_stamp_owned_by_stripped_arm(stable_path, monkeypatch):
+    """When the arm that carried the old saved_at is stripped, the surviving
+    freshly-measured arm must be promoted with a fresh stamp — not pre-aged
+    by data that no longer exists."""
+    import time
+
+    old_ts = time.time() - 23 * 3600
+    prev = _partial(12800, 3, 0, saved_at=old_ts)
+    prev["instr"]["off_injection_calibrated"] = False
+    prev["arm_saved_at"] = {"off": old_ts}
+    stable_path.write_text(json.dumps(prev))
+
+    def child(out, resume):
+        # emulate run_arms: resume the (poisoned) off arm with its per-arm
+        # stamp, run the on arm fresh and calibrated
+        with open(resume) as f:
+            r = json.load(f)
+        p = _partial(12800, 3, 4)
+        p["instr"]["off_injection_calibrated"] = False
+        p["arm_saved_at"] = dict(r.get("arm_saved_at") or {})
+        p["saved_at"] = r["saved_at"]
+        with open(out, "w") as f:
+            json.dump(p, f)
+        return 0
+
+    _custom_child(monkeypatch, [child])
+    res = bench._try_arms(False, deadline=time.time() + 1e9, retries=1)
+    assert res is None  # rejected A/B: no result this invocation
+    saved = json.loads(stable_path.read_text())
+    assert not saved.get("off")  # poisoned arm stripped
+    assert len(saved["on"]) == 4  # fresh survivor promoted
+    assert saved["saved_at"] == pytest.approx(time.time(), abs=60)
+
+
 def _cached_artifact(tmp_path, monkeypatch, *, backend="tpu", ts=None):
     path = tmp_path / "BENCH_local_tpu.json"
     res = {

@@ -552,7 +552,10 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
             )
             clean_exit = proc is not None and proc.returncode == 0
             if poisoned or (all_complete and clean_exit):
-                calib_rejected = True
+                # the no-shrink exemption only holds when the attempt itself
+                # finished: a timeout/crash mid-sibling-arm still means the
+                # budget may be the problem, so the ladder stays armed
+                calib_rejected = clean_exit
                 for a in poisoned:
                     partial.pop(a, None)
                     (partial.get("arm_saved_at") or {}).pop(a, None)

@@ -20,9 +20,49 @@ from dynamic_load_balance_distributeddnn_tpu.obs.logging import (
 )
 
 
+def _maybe_init_distributed(cfg) -> None:
+    """Multi-host rendezvous from the shipped entry point — the analogue of
+    the reference's MASTER_ADDR/MASTER_PORT + init_process_group('gloo')
+    (dbs.py:513-515). One process per HOST (SPMD across its chips), not one
+    per worker: the rendezvous makes every host see the global device mesh,
+    and the engines' collectives ride it. On TPU pods the coordinator can be
+    given alone (process count/id autodetected); on the CPU tier (tests) all
+    three are explicit and gloo backs the collectives."""
+    if not cfg.coordinator:
+        return
+    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+        initialize_multihost,
+    )
+
+    initialize_multihost(
+        cfg.coordinator,
+        num_processes=cfg.num_processes if cfg.num_processes > 0 else None,
+        process_id=cfg.process_id if cfg.process_id >= 0 else None,
+    )
+
+
+def _run_already_done_global(cfg) -> bool:
+    """The idempotence probe, made collective: per-process filesystems can
+    disagree (non-shared log_dirs, a config completed on one host only), and
+    a rank that skips while its peers train leaves the peers hung in their
+    first collective. Process 0 decides; everyone follows."""
+    skip = run_already_done(cfg)
+    if cfg.coordinator:
+        import jax
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        if jax.process_count() > 1:
+            skip = bool(
+                multihost_utils.broadcast_one_to_all(np.asarray(skip))
+            )
+    return skip
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     cfg = config_from_args(argv)
-    if run_already_done(cfg):
+    _maybe_init_distributed(cfg)
+    if _run_already_done_global(cfg):
         print("\n===========================")
         print("Had finished this experiment, skipping...")
         print("===========================\n")

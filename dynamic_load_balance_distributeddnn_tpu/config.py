@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 from typing import List, Optional, Sequence
 
 # Family-default names mirror the reference switch (dbs.py:345-362); explicit
@@ -37,6 +38,20 @@ def str2bool(v) -> bool:
     if v.lower() in ("no", "false", "f", "n", "0"):
         return False
     raise argparse.ArgumentTypeError("Boolean value expected.")
+
+
+def _env_int(name: str, default: int) -> int:
+    """Integer from the environment with a diagnosable failure: argparse's
+    type= only validates CLI-passed values, so an env-driven DEFAULT that
+    fails int() would otherwise kill parser construction with a contextless
+    ValueError. Empty/whitespace counts as unset."""
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise SystemExit(f"env var {name} must be an integer, got {v!r}")
 
 
 def device_map(v):
@@ -201,6 +216,21 @@ class Config:
                                        # path; multi-host replicates the
                                        # cache on every process's devices).
     device_cache_mb: int = 512         # HBM budget for the device cache
+    coordinator: str = ""              # multi-host rendezvous: coordinator
+                                       # "host:port" — the analogue of the
+                                       # reference's MASTER_ADDR/MASTER_PORT +
+                                       # init_process_group (dbs.py:513-515),
+                                       # mapped to jax.distributed.initialize.
+                                       # Non-empty -> the CLI initializes the
+                                       # distributed runtime before building
+                                       # the engine. Env: DBS_COORDINATOR.
+    num_processes: int = 0             # multi-host: total process count
+                                       # (dbs.py:538's world of processes; on
+                                       # TPU pods 0 lets JAX autodetect).
+                                       # Env: DBS_NUM_PROCESSES.
+    process_id: int = -1               # multi-host: this process's id; -1
+                                       # lets JAX autodetect (TPU pods).
+                                       # Env: DBS_PROCESS_ID.
     packed: str = "auto"               # "auto"|"on"|"off": single-device
                                        # packed epochs — when every worker
                                        # lives on ONE chip (the contention
@@ -389,6 +419,20 @@ def get_parser() -> argparse.ArgumentParser:
                    help="Single-device packed epochs: concat all workers' "
                         "true-width batches into one compiled whole-epoch "
                         "scan when every worker shares one chip.")
+    p.add_argument("--coordinator", type=str,
+                   default=os.environ.get("DBS_COORDINATOR", d.coordinator),
+                   help="Multi-host: coordinator host:port for "
+                        "jax.distributed.initialize (the reference's "
+                        "MASTER_ADDR/PORT rendezvous, dbs.py:513-515). "
+                        "Empty = single-host.")
+    p.add_argument("--num_processes", type=int,
+                   default=_env_int("DBS_NUM_PROCESSES", d.num_processes),
+                   help="Multi-host: total number of processes (0 = let JAX "
+                        "autodetect, TPU pods).")
+    p.add_argument("--process_id", type=int,
+                   default=_env_int("DBS_PROCESS_ID", d.process_id),
+                   help="Multi-host: this process's id (-1 = let JAX "
+                        "autodetect, TPU pods).")
     return p
 
 

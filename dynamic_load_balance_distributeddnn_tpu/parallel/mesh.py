@@ -25,9 +25,13 @@ DATA_AXIS = "data"
 
 
 def initialize_multihost(coordinator: Optional[str] = None, **kw) -> None:
-    """Cross-host rendezvous. No-op when single-process."""
-    if jax.process_count() > 1 or coordinator is not None:
-        jax.distributed.initialize(coordinator_address=coordinator, **kw)
+    """Cross-host rendezvous (the MASTER_ADDR/PORT + init_process_group
+    analogue, dbs.py:513-515). No-op without a coordinator, and idempotent —
+    wrappers that call the CLI several times in one process (sweeps,
+    gen_statis) must not re-initialize."""
+    if coordinator is None or jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(coordinator_address=coordinator, **kw)
 
 
 def data_mesh(devices: Optional[Sequence] = None, axis: str = DATA_AXIS) -> Mesh:

@@ -7,33 +7,59 @@ import math
 import flax.linen as nn
 
 
-class GroupNorm(nn.Module):
-    """GroupNorm routed through the fused Pallas kernel (ops/pallas/groupnorm).
+class GroupNorm(nn.GroupNorm):
+    """``nn.GroupNorm`` with two compute-only extensions: an optional relu
+    epilogue and routing through the fused Pallas kernel
+    (ops/pallas/groupnorm).
 
-    Deliberately named ``GroupNorm`` so flax auto-naming produces the same
-    submodule names ("GroupNorm_N") — and therefore the same param pytree
-    ("scale"/"bias" of shape [C]) — as ``nn.GroupNorm``. The Pallas toggle is
-    thus compute-only: checkpoints and param trees are identical across it,
-    and flipping it between traces can never desynchronize parameters.
+    Subclassing keeps the flax auto-name ("GroupNorm_N") and the param
+    pytree ("scale"/"bias" of shape [C]) identical to ``nn.GroupNorm`` in
+    BOTH branches, so checkpoints and param trees are invariant to the
+    Pallas toggle and flipping it between traces can never desynchronize
+    parameters. The non-Pallas branch is literally the flax implementation
+    (``super().__call__``): exact numerics by construction.
 
-    Same math as ``nn.GroupNorm``: stats in f32 with non-negative-clamped
-    variance, epsilon 1e-6.
+    ``relu=True`` applies the relu INSIDE the module — the Pallas kernel
+    fuses it as an epilogue (one pass instead of GN-then-relu; XLA cannot
+    elide a relu over a custom-call output it cannot prove nonnegative, so
+    an outer relu would re-pay the elementwise HBM round trip the fusion
+    saves), and the fallback branch runs ``nn.relu`` where XLA fuses it
+    into the normalize pass itself.
     """
 
-    num_groups: int
-    epsilon: float = 1e-6
+    relu: bool = False
+    use_pallas_kernel: bool = False
 
     @nn.compact
     def __call__(self, x):
-        from dynamic_load_balance_distributeddnn_tpu.ops.pallas import fused_group_norm
+        if self.use_pallas_kernel:
+            from dynamic_load_balance_distributeddnn_tpu.ops.pallas import (
+                fused_group_norm,
+            )
 
-        c = x.shape[-1]
-        scale = self.param("scale", nn.initializers.ones, (c,))
-        bias = self.param("bias", nn.initializers.zeros, (c,))
-        return fused_group_norm(x, scale, bias, self.num_groups, self.epsilon)
+            # the kernel implements the default nn.GroupNorm configuration
+            # only; honoring these silently-diverging knobs in one branch but
+            # not the other would break the both-branches-identical contract
+            if (
+                not self.use_scale
+                or not self.use_bias
+                or self.group_size is not None
+            ):
+                raise NotImplementedError(
+                    "Pallas GroupNorm supports the default "
+                    "use_scale/use_bias/num_groups configuration only"
+                )
+            c = x.shape[-1]
+            scale = self.param("scale", nn.initializers.ones, (c,))
+            bias = self.param("bias", nn.initializers.zeros, (c,))
+            return fused_group_norm(
+                x, scale, bias, self.num_groups, self.epsilon, relu=self.relu
+            )
+        y = super().__call__(x)
+        return nn.relu(y) if self.relu else y
 
 
-def group_norm(channels: int, groups: int = 32) -> nn.Module:
+def group_norm(channels: int, groups: int = 32, relu: bool = False) -> nn.Module:
     """GroupNorm with the reference's group count where it divides the
     channel count, else the largest divisor of it that does.
 
@@ -46,10 +72,13 @@ def group_norm(channels: int, groups: int = 32) -> nn.Module:
     time), the returned module runs the fused TPU kernel. Both branches have
     identical names and parameters (see GroupNorm above), so the toggle
     affects the compute path only.
+
+    ``relu=True`` fuses the GN→relu pair every CNN block uses (e.g.
+    Net/Densenet.py:16-19) inside the module; call sites must NOT apply an
+    outer relu on top (it would cost the extra elementwise pass the fusion
+    exists to remove).
     """
     from dynamic_load_balance_distributeddnn_tpu.ops import pallas as pk
 
     g = math.gcd(groups, channels)
-    if pk.use_pallas():
-        return GroupNorm(num_groups=g)
-    return nn.GroupNorm(num_groups=g)
+    return GroupNorm(num_groups=g, relu=relu, use_pallas_kernel=pk.use_pallas())

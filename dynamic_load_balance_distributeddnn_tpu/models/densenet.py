@@ -3,6 +3,19 @@
 Constructors 121/169/201/161 mirror Net/Densenet.py:87-100; `-m densenet`
 selects DenseNet-121 with growth 32 (dbs.py:353) — the model of the canonical
 README recipe and the benchmark north star.
+
+TPU note (the roofline lever, artifacts/ROOFLINE.md): DenseNet is
+bandwidth-bound on v5e, and the naive translation of the reference's
+``torch.cat([out, x], 1)`` per layer (Net/Densenet.py:20) re-materializes the
+whole growing feature map every layer — O(L²·g) concat traffic per dense
+block. Here each block instead pre-allocates its final-width buffer once and
+every layer writes only its ``growth_rate`` new channels into it with a
+static-offset slice update, which XLA aliases in place — O(L·g) write
+traffic. The buffer fills RIGHT-TO-LEFT so the live prefix ``buf[..., s:]``
+reads ``[out_{i-1}, ..., out_0, x]`` — exactly the channel order the nested
+reference concat produces, so the math (and GroupNorm's channel grouping) is
+unchanged. ``use_buffer=False`` keeps the literal concat for equivalence
+tests.
 """
 
 from __future__ import annotations
@@ -17,20 +30,23 @@ from dynamic_load_balance_distributeddnn_tpu.models.common import group_norm
 
 
 class DenseBottleneck(nn.Module):
+    """GN→relu→1×1 conv→GN→relu→3×3 conv producing ``growth_rate`` new
+    channels (Net/Densenet.py:9-21). The concat with the input lives in
+    ``DenseNet`` (see module docstring); this module returns only the new
+    features."""
+
     growth_rate: int
 
     @nn.compact
     def __call__(self, x):
         in_planes = x.shape[-1]
         out = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False)(
-            nn.relu(group_norm(in_planes)(x))
+            group_norm(in_planes, relu=True)(x)
         )
         out = nn.Conv(self.growth_rate, (3, 3), padding=1, use_bias=False)(
-            nn.relu(group_norm(4 * self.growth_rate)(out))
+            group_norm(4 * self.growth_rate, relu=True)(out)
         )
-        # NHWC concat on channels (reference cats on dim 1 in NCHW,
-        # Net/Densenet.py:20)
-        return jnp.concatenate([out, x], axis=-1)
+        return out
 
 
 class Transition(nn.Module):
@@ -40,7 +56,7 @@ class Transition(nn.Module):
     def __call__(self, x):
         in_planes = x.shape[-1]
         out = nn.Conv(self.out_planes, (1, 1), use_bias=False)(
-            nn.relu(group_norm(in_planes)(x))
+            group_norm(in_planes, relu=True)(x)
         )
         return nn.avg_pool(out, (2, 2), strides=(2, 2))
 
@@ -50,6 +66,29 @@ class DenseNet(nn.Module):
     growth_rate: int = 12
     reduction: float = 0.5
     num_classes: int = 10
+    use_buffer: bool = True  # False: literal per-layer concat (test oracle)
+
+    def _dense_block(self, x, nblock: int):
+        """One dense block; returns the full-width feature map equal to the
+        reference's nested ``cat([out, x], C)`` chain."""
+        g = self.growth_rate
+        if not self.use_buffer:
+            for _ in range(nblock):
+                out = DenseBottleneck(growth_rate=g)(x)
+                # NHWC concat on channels (reference cats on dim 1 in NCHW,
+                # Net/Densenet.py:20)
+                x = jnp.concatenate([out, x], axis=-1)
+            return x
+        c0 = x.shape[-1]
+        c_final = c0 + nblock * g
+        buf = jnp.zeros(x.shape[:-1] + (c_final,), x.dtype)
+        start = c_final - c0
+        buf = buf.at[..., start:].set(x)
+        for _ in range(nblock):
+            out = DenseBottleneck(growth_rate=g)(buf[..., start:])
+            start -= g
+            buf = buf.at[..., start : start + g].set(out)
+        return buf  # start == 0: fully filled
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -57,30 +96,29 @@ class DenseNet(nn.Module):
         num_planes = 2 * g
         x = nn.Conv(num_planes, (3, 3), padding=1, use_bias=False)(x)
         for bi, nblock in enumerate(self.nblocks):
-            for _ in range(nblock):
-                x = DenseBottleneck(growth_rate=g)(x)
+            x = self._dense_block(x, nblock)
             num_planes += nblock * g
             if bi != len(self.nblocks) - 1:
                 out_planes = int(math.floor(num_planes * self.reduction))
                 x = Transition(out_planes=out_planes)(x)
                 num_planes = out_planes
-        x = nn.relu(group_norm(num_planes)(x))
+        x = group_norm(num_planes, relu=True)(x)
         x = nn.avg_pool(x, (4, 4), strides=(4, 4))
         x = x.reshape(x.shape[0], -1)
         return nn.Dense(self.num_classes)(x)
 
 
-def DenseNet121(num_classes=10):
-    return DenseNet((6, 12, 24, 16), growth_rate=32, num_classes=num_classes)
+def DenseNet121(num_classes=10, **kw):
+    return DenseNet((6, 12, 24, 16), growth_rate=32, num_classes=num_classes, **kw)
 
 
-def DenseNet169(num_classes=10):
-    return DenseNet((6, 12, 32, 32), growth_rate=32, num_classes=num_classes)
+def DenseNet169(num_classes=10, **kw):
+    return DenseNet((6, 12, 32, 32), growth_rate=32, num_classes=num_classes, **kw)
 
 
-def DenseNet201(num_classes=10):
-    return DenseNet((6, 12, 48, 32), growth_rate=32, num_classes=num_classes)
+def DenseNet201(num_classes=10, **kw):
+    return DenseNet((6, 12, 48, 32), growth_rate=32, num_classes=num_classes, **kw)
 
 
-def DenseNet161(num_classes=10):
-    return DenseNet((6, 12, 36, 24), growth_rate=48, num_classes=num_classes)
+def DenseNet161(num_classes=10, **kw):
+    return DenseNet((6, 12, 36, 24), growth_rate=48, num_classes=num_classes, **kw)

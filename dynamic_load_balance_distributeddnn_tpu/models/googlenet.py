@@ -18,7 +18,7 @@ from dynamic_load_balance_distributeddnn_tpu.models.common import group_norm
 
 def _conv_gn_relu(x, features: int, kernel: int, groups: int):
     x = nn.Conv(features, (kernel, kernel), padding=kernel // 2)(x)
-    return nn.relu(group_norm(features, groups)(x))
+    return group_norm(features, groups, relu=True)(x)
 
 
 class Inception(nn.Module):

@@ -42,7 +42,7 @@ class RegNetBlock(nn.Module):
         num_groups = w_b // self.group_width
 
         out = nn.Conv(w_b, (1, 1), use_bias=False)(x)
-        out = nn.relu(group_norm(w_b)(out))
+        out = group_norm(w_b, relu=True)(out)
         out = nn.Conv(
             w_b,
             (3, 3),
@@ -51,7 +51,7 @@ class RegNetBlock(nn.Module):
             feature_group_count=num_groups,
             use_bias=False,
         )(out)
-        out = nn.relu(group_norm(w_b)(out))
+        out = group_norm(w_b, relu=True)(out)
         if self.se_ratio > 0:
             out = SE(se_planes=int(round(w_in * self.se_ratio)))(out)
         out = nn.Conv(self.w_out, (1, 1), use_bias=False)(out)
@@ -72,7 +72,7 @@ class RegNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.Conv(64, (3, 3), padding=1, use_bias=False)(x)
-        x = nn.relu(group_norm(64)(x))
+        x = group_norm(64, relu=True)(x)
         for idx in range(4):
             depth = self.cfg["depths"][idx]
             width = self.cfg["widths"][idx]

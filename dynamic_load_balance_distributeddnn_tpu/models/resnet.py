@@ -25,7 +25,7 @@ class BasicBlock(nn.Module):
     def __call__(self, x):
         in_planes = x.shape[-1]
         out = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1, use_bias=False)(x)
-        out = nn.relu(group_norm(self.planes)(out))
+        out = group_norm(self.planes, relu=True)(out)
         out = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(out)
         out = group_norm(self.planes)(out)
         if self.stride != 1 or in_planes != self.expansion * self.planes:
@@ -47,9 +47,9 @@ class Bottleneck(nn.Module):
     def __call__(self, x):
         in_planes = x.shape[-1]
         out = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
-        out = nn.relu(group_norm(self.planes)(out))
+        out = group_norm(self.planes, relu=True)(out)
         out = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1, use_bias=False)(out)
-        out = nn.relu(group_norm(self.planes)(out))
+        out = group_norm(self.planes, relu=True)(out)
         out = nn.Conv(self.expansion * self.planes, (1, 1), use_bias=False)(out)
         out = group_norm(self.expansion * self.planes)(out)
         if self.stride != 1 or in_planes != self.expansion * self.planes:
@@ -70,7 +70,7 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.Conv(64, (3, 3), padding=1, use_bias=False)(x)
-        x = nn.relu(group_norm(64)(x))
+        x = group_norm(64, relu=True)(x)
         for planes, blocks, stride in zip(
             (64, 128, 256, 512), self.num_blocks, (1, 2, 2, 2)
         ):

@@ -30,7 +30,7 @@ from dynamic_load_balance_distributeddnn_tpu.ops import pallas as _pk
 
 
 def _gn_fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, rstd_ref,
-                   *, groups: int, eps: float):
+                   *, groups: int, eps: float, relu: bool):
     x = x_ref[0].astype(jnp.float32)            # [S, C]
     s_dim, c = x.shape
     cg = c // groups
@@ -57,14 +57,20 @@ def _gn_fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, rstd_ref,
     rstd_c = jnp.dot(rstd, member.T, preferred_element_type=jnp.float32,
                   precision=jax.lax.Precision.HIGHEST)
     y = (x - mean_c) * rstd_c * scale_ref[...] + bias_ref[...]
+    if relu:
+        # fused epilogue: saves the separate elementwise pass (and its HBM
+        # round trip) that a GN-then-relu pair costs outside the kernel
+        y = jnp.maximum(y, 0.0)
     y_ref[0] = y.astype(y_ref.dtype)
     mean_ref[0] = mean
     rstd_ref[0] = rstd
 
 
-def _fwd_impl(x3, scale, bias, groups: int, eps: float, interpret: bool):
+def _fwd_impl(x3, scale, bias, groups: int, eps: float, interpret: bool,
+              relu: bool):
     b, s_dim, c = x3.shape
-    kernel = functools.partial(_gn_fwd_kernel, groups=groups, eps=eps)
+    kernel = functools.partial(_gn_fwd_kernel, groups=groups, eps=eps,
+                               relu=relu)
     call = pl.pallas_call(
         kernel,
         grid=(b,),
@@ -89,19 +95,20 @@ def _fwd_impl(x3, scale, bias, groups: int, eps: float, interpret: bool):
     return y, mean[:, 0], rstd[:, 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _fused_gn(x3, scale, bias, groups: int, eps: float, interpret: bool):
-    y, _, _ = _fwd_impl(x3, scale, bias, groups, eps, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fused_gn(x3, scale, bias, groups: int, eps: float, interpret: bool,
+              relu: bool):
+    y, _, _ = _fwd_impl(x3, scale, bias, groups, eps, interpret, relu)
     return y
 
 
-def _fused_gn_fwd(x3, scale, bias, groups, eps, interpret):
-    y, mean, rstd = _fwd_impl(x3, scale, bias, groups, eps, interpret)
-    return y, (x3, scale, mean, rstd)
+def _fused_gn_fwd(x3, scale, bias, groups, eps, interpret, relu):
+    y, mean, rstd = _fwd_impl(x3, scale, bias, groups, eps, interpret, relu)
+    return y, (x3, scale, bias, mean, rstd)
 
 
-def _fused_gn_bwd(groups, eps, interpret, res, dy):
-    x3, scale, mean, rstd = res
+def _fused_gn_bwd(groups, eps, interpret, relu, res, dy):
+    x3, scale, bias, mean, rstd = res
     b, s_dim, c = x3.shape
     cg = c // groups
     n = s_dim * cg
@@ -109,6 +116,11 @@ def _fused_gn_bwd(groups, eps, interpret, res, dy):
     dyf = dy.astype(jnp.float32)
     xhat = (xf - mean[:, None, :, None]) * rstd[:, None, :, None]
     xhat = xhat.reshape(b, s_dim, c)
+    if relu:
+        # relu VJP folded in: recompute the pre-relu output's sign from the
+        # saved stats (no extra residual tensor) and zero the dead lanes
+        pre = xhat * scale[None, None, :] + bias[None, None, :]
+        dyf = jnp.where(pre > 0, dyf, 0.0)
     dxhat = (dyf * scale[None, None, :]).reshape(b, s_dim, groups, cg)
     xhat_g = xhat.reshape(b, s_dim, groups, cg)
     sum_dxhat = jnp.sum(dxhat, axis=(1, 3), keepdims=True)
@@ -131,8 +143,11 @@ def fused_group_norm(
     groups: int,
     eps: float = 1e-6,
     interpret: Optional[bool] = None,
+    relu: bool = False,
 ) -> jnp.ndarray:
-    """GroupNorm over the trailing channel axis of [B, ..., C].
+    """GroupNorm over the trailing channel axis of [B, ..., C], optionally
+    with a fused relu epilogue (``relu=True``) — the GN→relu pair that every
+    CNN block in the zoo uses (e.g. Net/Densenet.py:16-19) in one pass.
 
     Stats are computed in f32 regardless of input dtype (bf16-safe); the
     output matches the input dtype.
@@ -148,5 +163,5 @@ def fused_group_norm(
         s_dim *= d
     x3 = x.reshape(b, s_dim, c)
     y = _fused_gn(x3, scale.astype(jnp.float32), bias.astype(jnp.float32),
-                  groups, eps, interpret)
+                  groups, eps, interpret, relu)
     return y.reshape(shape)

@@ -122,24 +122,41 @@ def bench_groupnorm(results, dtype, repeats, quick):
         scale = jax.random.normal(ks, (c,), jnp.float32)
         bias = jnp.zeros((c,), jnp.float32)
 
-        pall = jax.jit(lambda x, s, b_: fused_group_norm(x, s, b_, groups, interpret=False))
-        base = jax.jit(lambda x, s, b_: xla_group_norm(x, s, b_, groups))
-        pall_g = jax.jit(jax.grad(lambda x, s, b_: fused_group_norm(x, s, b_, groups, interpret=False).sum(), argnums=(0, 1, 2)))
-        base_g = jax.jit(jax.grad(lambda x, s, b_: xla_group_norm(x, s, b_, groups).sum(), argnums=(0, 1, 2)))
-        row = {
-            "kernel": "fused_group_norm",
-            "shape": f"B{b}x{hh}x{ww}xC{c}/g{groups}",
-            "dtype": str(dtype.__name__),
-        }
-        try:
-            row["fwd_pallas_ms"] = timeit(pall, x, scale, bias, repeats=repeats) * 1e3
-            row["fwd_xla_ms"] = timeit(base, x, scale, bias, repeats=repeats) * 1e3
-            row["grad_pallas_ms"] = timeit(pall_g, x, scale, bias, repeats=repeats) * 1e3
-            row["grad_xla_ms"] = timeit(base_g, x, scale, bias, repeats=repeats) * 1e3
-        except Exception as e:
-            row["error"] = f"{type(e).__name__}: {e}"[:300]
-        results.append(row)
-        print(json.dumps(row), flush=True)
+        # plain GN, and the GN->relu pair every CNN block actually runs
+        # (models/*: nn.relu(group_norm(...))) with the kernel's fused
+        # relu epilogue vs XLA fusing the pair itself
+        variants = [
+            ("fused_group_norm",
+             lambda x, s, b_: fused_group_norm(x, s, b_, groups, interpret=False),
+             lambda x, s, b_: xla_group_norm(x, s, b_, groups)),
+            ("fused_group_norm_relu",
+             # bare kernel call, as the models run it (group_norm(relu=True)
+             # with NO outer relu — an outer relu over the custom call would
+             # re-add the elementwise pass the epilogue removes)
+             lambda x, s, b_: fused_group_norm(
+                 x, s, b_, groups, interpret=False, relu=True
+             ),
+             lambda x, s, b_: jax.nn.relu(xla_group_norm(x, s, b_, groups))),
+        ]
+        for kname, pfn, bfn in variants:
+            pall = jax.jit(pfn)
+            base = jax.jit(bfn)
+            pall_g = jax.jit(jax.grad(lambda x, s, b_: pfn(x, s, b_).sum(), argnums=(0, 1, 2)))
+            base_g = jax.jit(jax.grad(lambda x, s, b_: bfn(x, s, b_).sum(), argnums=(0, 1, 2)))
+            row = {
+                "kernel": kname,
+                "shape": f"B{b}x{hh}x{ww}xC{c}/g{groups}",
+                "dtype": str(dtype.__name__),
+            }
+            try:
+                row["fwd_pallas_ms"] = timeit(pall, x, scale, bias, repeats=repeats) * 1e3
+                row["fwd_xla_ms"] = timeit(base, x, scale, bias, repeats=repeats) * 1e3
+                row["grad_pallas_ms"] = timeit(pall_g, x, scale, bias, repeats=repeats) * 1e3
+                row["grad_xla_ms"] = timeit(base_g, x, scale, bias, repeats=repeats) * 1e3
+            except Exception as e:
+                row["error"] = f"{type(e).__name__}: {e}"[:300]
+            results.append(row)
+            print(json.dumps(row), flush=True)
 
 
 def bench_xent(results, dtype, repeats, quick):

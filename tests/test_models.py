@@ -46,6 +46,34 @@ def test_cnn_families_exact_param_parity(name, nc, expect):
     assert n == expect, f"{name}: {n:,} params != reference {expect:,}"
 
 
+def test_densenet_buffer_matches_concat():
+    """The dense block's pre-allocated right-to-left buffer (the roofline
+    byte cut, models/densenet.py docstring) is numerically the reference's
+    nested concat: same param tree, bitwise-equal forward, grads equal to
+    fp tolerance."""
+    from dynamic_load_balance_distributeddnn_tpu.models.densenet import DenseNet
+
+    m_buf = DenseNet((3, 4), growth_rate=32, num_classes=10, use_buffer=True)
+    m_cat = DenseNet((3, 4), growth_rate=32, num_classes=10, use_buffer=False)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 32, 32, 3), jnp.float32)
+    p1 = m_buf.init(jax.random.PRNGKey(0), x, train=False)
+    p2 = m_cat.init(jax.random.PRNGKey(0), x, train=False)
+    assert jax.tree_util.tree_structure(p1) == jax.tree_util.tree_structure(p2)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    o1 = m_buf.apply(p1, x, train=False)
+    o2 = m_cat.apply(p1, x, train=False)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+
+    g1 = jax.grad(lambda p: jnp.sum(m_buf.apply(p, x, train=False) ** 2))(p1)
+    g2 = jax.grad(lambda p: jnp.sum(m_cat.apply(p, x, train=False) ** 2))(p1)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        )
+
+
 @pytest.mark.slow
 def test_googlenet_fixed_runs():
     spec = build_model("googlenet", num_classes=10)

@@ -54,6 +54,37 @@ def test_groupnorm_grads_match_flax():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4)
 
 
+def test_groupnorm_relu_epilogue_matches_gn_then_relu():
+    """relu=True fuses the GN→relu pair (the zoo-wide block pattern) into
+    the kernel; forward and grads must match the unfused composition —
+    including the idempotence contract models rely on (an OUTER nn.relu on
+    the fused output is a no-op, models/common.py group_norm docstring)."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 5, 7, 32).astype(np.float32))
+    scale = jnp.asarray(rng.randn(32).astype(np.float32))
+    bias = jnp.asarray(rng.randn(32).astype(np.float32))
+    gn = nn.GroupNorm(num_groups=16)
+    ref = nn.relu(gn.apply({"params": {"scale": scale, "bias": bias}}, x))
+    got = fused_group_norm(x, scale, bias, 16, relu=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(nn.relu(got)), np.asarray(got))
+
+    def f_ref(x, s, b):
+        return jnp.sum(
+            jnp.tanh(
+                nn.relu(gn.apply({"params": {"scale": s, "bias": b}}, x))
+            )
+        )
+
+    def f_got(x, s, b):
+        return jnp.sum(jnp.tanh(fused_group_norm(x, s, b, 16, relu=True)))
+
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(x, scale, bias)
+    gg = jax.grad(f_got, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_ in zip(gr, gg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4)
+
+
 def test_groupnorm_bf16_output_dtype():
     x = jnp.ones((2, 4, 4, 16), jnp.bfloat16)
     y = fused_group_norm(x, jnp.ones(16), jnp.zeros(16), 8)
@@ -101,6 +132,28 @@ def test_pallas_groupnorm_module_swaps_in():
     finally:
         set_use_pallas(False)
     assert isinstance(group_norm(32), nn.GroupNorm)
+
+
+def test_groupnorm_module_relu_toggle_equivalent():
+    """group_norm(relu=True) applies relu INSIDE the module in both branches
+    (kernel epilogue when Pallas is on, nn.relu in the flax fallback) with
+    the same params — the compute-only-toggle contract extended to the
+    fused GN→relu pair."""
+    from dynamic_load_balance_distributeddnn_tpu.models.common import group_norm
+
+    x = jnp.asarray(np.random.RandomState(6).randn(2, 5, 5, 32).astype(np.float32))
+    mod_off = group_norm(32, relu=True)
+    params = mod_off.init(jax.random.PRNGKey(0), x)
+    y_off = mod_off.apply(params, x)
+    # relu is genuinely applied (about half the normalized activations clip)
+    assert float(jnp.min(y_off)) == 0.0
+
+    set_use_pallas(True)
+    try:
+        y_on = group_norm(32, relu=True).apply(params, x)
+    finally:
+        set_use_pallas(False)
+    np.testing.assert_allclose(np.asarray(y_off), np.asarray(y_on), atol=1e-4)
 
 
 @pytest.mark.slow  # ~56s: two DenseNet inits

@@ -38,16 +38,24 @@ class GroupNorm(nn.GroupNorm):
             )
 
             # the kernel implements the default nn.GroupNorm configuration
-            # only; honoring these silently-diverging knobs in one branch but
+            # only (num_groups/epsilon/relu are the supported knobs);
+            # silently honoring any other inherited field in one branch but
             # not the other would break the both-branches-identical contract
-            if (
-                not self.use_scale
-                or not self.use_bias
-                or self.group_size is not None
-            ):
+            fields = nn.GroupNorm.__dataclass_fields__
+            unsupported = [
+                f
+                for f in (
+                    "use_scale", "use_bias", "group_size", "scale_init",
+                    "bias_init", "dtype", "param_dtype", "axis_name",
+                    "axis_index_groups", "use_fast_variance",
+                    "force_float32_reductions", "reduction_axes",
+                )
+                if f in fields and getattr(self, f) != fields[f].default
+            ]
+            if unsupported:
                 raise NotImplementedError(
-                    "Pallas GroupNorm supports the default "
-                    "use_scale/use_bias/num_groups configuration only"
+                    "Pallas GroupNorm requires default nn.GroupNorm config; "
+                    f"non-default: {unsupported}"
                 )
             c = x.shape[-1]
             scale = self.param("scale", nn.initializers.ones, (c,))

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Run a heavy host job, SIGSTOPping it whenever the TPU queue is mid-leg
+# (.tpu_busy at the repo root) — heavy host work running concurrently with
+# an on-chip measurement poisons the chip timing (round-2 lesson). The
+# job's own walls are sacrificial: epochs that overlap a pause are ruined
+# and the job should simply be re-run (its sentinels make that cheap).
+cd "$(dirname "$0")/.."
+"$@" &
+PID=$!
+trap 'kill "$PID" 2>/dev/null' EXIT
+PAUSED=0
+while kill -0 "$PID" 2>/dev/null; do
+  if [ -f .tpu_busy ]; then
+    if [ "$PAUSED" = 0 ]; then kill -STOP "$PID" 2>/dev/null; PAUSED=1; echo "[host_job] paused for TPU leg"; fi
+  else
+    if [ "$PAUSED" = 1 ]; then kill -CONT "$PID" 2>/dev/null; PAUSED=0; echo "[host_job] resumed"; fi
+  fi
+  sleep 10
+done
+trap - EXIT
+wait "$PID"

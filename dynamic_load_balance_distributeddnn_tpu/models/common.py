@@ -40,17 +40,19 @@ class GroupNorm(nn.GroupNorm):
             # the kernel implements the default nn.GroupNorm configuration
             # only (num_groups/epsilon/relu are the supported knobs);
             # silently honoring any other inherited field in one branch but
-            # not the other would break the both-branches-identical contract
+            # not the other would break the both-branches-identical
+            # contract. Derived from the schema, not an enumerated list, so
+            # a knob added by a future flax version is rejected rather than
+            # silently ignored.
+            supported = {"num_groups", "epsilon", "relu", "use_pallas_kernel",
+                         "parent", "name"}
             fields = nn.GroupNorm.__dataclass_fields__
             unsupported = [
                 f
-                for f in (
-                    "use_scale", "use_bias", "group_size", "scale_init",
-                    "bias_init", "dtype", "param_dtype", "axis_name",
-                    "axis_index_groups", "use_fast_variance",
-                    "force_float32_reductions", "reduction_axes",
-                )
-                if f in fields and getattr(self, f) != fields[f].default
+                for f, spec in fields.items()
+                if f not in supported
+                and spec.init
+                and getattr(self, f, spec.default) != spec.default
             ]
             if unsupported:
                 raise NotImplementedError(

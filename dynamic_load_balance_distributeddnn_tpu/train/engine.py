@@ -472,6 +472,7 @@ class Trainer:
                 "shares": self.shares,
                 "node_times": self.node_times,
                 "total_wallclock": self.total_wallclock,
+                "total_probe_s": self.total_probe_s,
             },
         )
 
@@ -491,6 +492,8 @@ class Trainer:
             self.node_times = np.asarray(controller["node_times"], dtype=np.float64)
         if "total_wallclock" in controller:
             self.total_wallclock = float(controller["total_wallclock"])
+        if "total_probe_s" in controller:
+            self.total_probe_s = float(controller["total_probe_s"])
         self.logger.info(f"Resumed from checkpoint at epoch {epoch}")
         return epoch + 1
 

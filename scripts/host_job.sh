@@ -4,16 +4,22 @@
 # an on-chip measurement poisons the chip timing (round-2 lesson). The
 # job's own walls are sacrificial: epochs that overlap a pause are ruined
 # and the job should simply be re-run (its sentinels make that cheap).
+#
+# The job runs in its own session (setsid) and ALL signals target the
+# process group: stopping only the direct child would leave its
+# subprocesses (multiprocessing workers, chained scripts) burning CPU
+# during a TPU leg — the exact contention this wrapper exists to prevent.
 cd "$(dirname "$0")/.."
-"$@" &
+setsid "$@" &
 PID=$!
-trap 'kill "$PID" 2>/dev/null' EXIT
+# a stopped process ignores TERM until resumed — CONT first on exit
+trap 'kill -CONT -- "-$PID" 2>/dev/null; kill -- "-$PID" 2>/dev/null' EXIT
 PAUSED=0
 while kill -0 "$PID" 2>/dev/null; do
   if [ -f .tpu_busy ]; then
-    if [ "$PAUSED" = 0 ]; then kill -STOP "$PID" 2>/dev/null; PAUSED=1; echo "[host_job] paused for TPU leg"; fi
+    if [ "$PAUSED" = 0 ]; then kill -STOP -- "-$PID" 2>/dev/null; PAUSED=1; echo "[host_job] paused for TPU leg"; fi
   else
-    if [ "$PAUSED" = 1 ]; then kill -CONT "$PID" 2>/dev/null; PAUSED=0; echo "[host_job] resumed"; fi
+    if [ "$PAUSED" = 1 ]; then kill -CONT -- "-$PID" 2>/dev/null; PAUSED=0; echo "[host_job] resumed"; fi
   fi
   sleep 10
 done

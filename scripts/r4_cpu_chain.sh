@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-4 CPU-tier measurement chain (runs while the TPU queue waits for
+# the tunnel; host_job.sh pauses it during on-chip legs):
+#   1. wait for the already-running c4 RegNet ws=8 A/B to finish
+#   2. c1 accuracy-parity leg: 12-epoch fixed-seed paired mnistnet A/B
+#      (VERDICT r3 next #5 — enough epochs that dbs-on/off accuracy
+#      converges within noise)
+#   3. fresh CPU-insurance bench with round-4 code (probe cost now out of
+#      the walls — VERDICT r3 weak #7's IQR check)
+cd "$(dirname "$0")/.."
+set -u
+
+# 1. wait for any running c4 gen_statis
+while pgrep -f "gen_statis.py --out_dir artifacts/acceptance_cpu_small_r4" > /dev/null; do
+  sleep 30
+done
+
+# 2. c1 parity (12 epochs); sentinel-idempotent
+STATIS_CPU=1 STATIS_ONLY=c1_mnistnet STATIS_NTRAIN=2048 STATIS_EPOCHS=12 \
+  bash scripts/host_job.sh python scripts/gen_statis.py \
+  --out_dir artifacts/acceptance_cpu_small_r4 >> /tmp/c1_parity.log 2>&1
+
+# 3. round-4 CPU insurance bench (standard insurance scale)
+BENCH_FORCE_CPU=1 BENCH_CPU_NTRAIN=2048 BENCH_EPOCHS=7 \
+  BENCH_PARTIAL_PATH=artifacts/.bench_partial_cpu_r4.json \
+  BENCH_TOTAL_BUDGET=2400 \
+  bash scripts/host_job.sh sh -c \
+  'python bench.py > artifacts/BENCH_cpu_insurance_r4.json 2>/tmp/bench_r4_cpu.log' \
+  >> /tmp/bench_r4_cpu_outer.log 2>&1
+
+echo "[r4_chain] done at $(date -u +%H:%M:%S)"

@@ -44,15 +44,26 @@ class GroupNorm(nn.GroupNorm):
             # contract. Derived from the schema, not an enumerated list, so
             # a knob added by a future flax version is rejected rather than
             # silently ignored.
+            import dataclasses as _dc
+
             supported = {"num_groups", "epsilon", "relu", "use_pallas_kernel",
                          "parent", "name"}
             fields = nn.GroupNorm.__dataclass_fields__
+
+            def _default(spec):
+                if spec.default is not _dc.MISSING:
+                    return spec.default
+                if spec.default_factory is not _dc.MISSING:
+                    return spec.default_factory()
+                return _dc.MISSING  # required field: nothing to compare
+
             unsupported = [
                 f
                 for f, spec in fields.items()
                 if f not in supported
                 and spec.init
-                and getattr(self, f, spec.default) != spec.default
+                and _default(spec) is not _dc.MISSING
+                and getattr(self, f, None) != _default(spec)
             ]
             if unsupported:
                 raise NotImplementedError(

@@ -12,6 +12,14 @@
 cd "$(dirname "$0")/.."
 setsid "$@" &
 PID=$!
+# wait for the child to become its own group leader — group signals sent
+# before setsid(2) completes would silently miss (ESRCH), letting the job
+# run unthrottled through a TPU leg or escape the exit cleanup
+for _ in $(seq 1 50); do
+  [ "$(ps -o pgid= -p "$PID" 2>/dev/null | tr -d ' ')" = "$PID" ] && break
+  kill -0 "$PID" 2>/dev/null || break
+  sleep 0.1
+done
 # a stopped process ignores TERM until resumed — CONT first on exit
 trap 'kill -CONT -- "-$PID" 2>/dev/null; kill -- "-$PID" 2>/dev/null' EXIT
 PAUSED=0

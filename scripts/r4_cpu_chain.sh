@@ -20,12 +20,15 @@ STATIS_CPU=1 STATIS_ONLY=c1_mnistnet STATIS_NTRAIN=2048 STATIS_EPOCHS=12 \
   bash scripts/host_job.sh python scripts/gen_statis.py \
   --out_dir artifacts/acceptance_cpu_small_r4 >> /tmp/c1_parity.log 2>&1
 
-# 3. round-4 CPU insurance bench (standard insurance scale)
+# 3. round-4 CPU insurance bench (standard insurance scale); write to a
+#    temp path and promote on success so an interrupted run can never
+#    truncate the committed artifact
 BENCH_FORCE_CPU=1 BENCH_CPU_NTRAIN=2048 BENCH_EPOCHS=7 \
   BENCH_PARTIAL_PATH=artifacts/.bench_partial_cpu_r4.json \
   BENCH_TOTAL_BUDGET=2400 \
   bash scripts/host_job.sh sh -c \
-  'python bench.py > artifacts/BENCH_cpu_insurance_r4.json 2>/tmp/bench_r4_cpu.log' \
+  'python bench.py > artifacts/.BENCH_cpu_insurance_r4.tmp 2>/tmp/bench_r4_cpu.log \
+     && mv artifacts/.BENCH_cpu_insurance_r4.tmp artifacts/BENCH_cpu_insurance_r4.json' \
   >> /tmp/bench_r4_cpu_outer.log 2>&1
 
 echo "[r4_chain] done at $(date -u +%H:%M:%S)"

@@ -18,6 +18,17 @@ STAMPS=artifacts/.queue3
 mkdir -p "$STAMPS" artifacts
 trap 'rm -f .tpu_busy' EXIT
 
+# Commit any artifact evidence the moment a leg produces it — the round-3
+# lesson is that a tunnel window can close before a round ends, and the
+# round-4 lesson is that it may never open again. Committed == survives.
+commit_evidence () {
+  git add -A artifacts/ 2>/dev/null
+  if ! git diff --cached --quiet -- artifacts/ 2>/dev/null; then
+    git commit -q -m "tpu queue: on-chip evidence ($1, $(date -u +%H:%M:%SZ))" -- artifacts/ || true
+    echo "[queue3] committed evidence after $1"
+  fi
+}
+
 leg () {  # leg <name> <timeout_s> <cmd...>
   local name="$1" tmo="$2"; shift 2
   [ -f "$STAMPS/$name.done" ] && return 0
@@ -28,11 +39,14 @@ leg () {  # leg <name> <timeout_s> <cmd...>
     touch "$STAMPS/$name.done"
     echo "[queue3] leg $name done"
     rm -f .tpu_busy
+    commit_evidence "$name"
     return 0
   else
     local rc=$?
     echo "[queue3] leg $name failed rc=$rc"
     rm -f .tpu_busy
+    # even a failed leg may have produced partial incremental artifacts
+    commit_evidence "$name (partial)"
     # tunnel still up right after the failure => the failure is REAL, not a
     # drop. Bound real failures (3 attempts) so one broken leg cannot
     # starve everything queued behind it; a drop keeps unlimited retries.
@@ -51,7 +65,7 @@ leg () {  # leg <name> <timeout_s> <cmd...>
 }
 
 all_done () {
-  for n in bench mfu flash kernels statis precision statis_c5; do
+  for n in micro bench mfu flash kernels statis precision statis_c5; do
     [ -f "$STAMPS/$n.done" ] || [ -f "$STAMPS/$n.gaveup" ] || return 1
   done
   return 0
@@ -67,6 +81,14 @@ while true; do
     # a failed leg usually means the tunnel dropped mid-run — go straight
     # back to the probe loop instead of burning every later leg's timeout
     # against a dead backend
+    #
+    # micro FIRST (VERDICT r4 #1): sized so a sub-2-minute window still
+    # commits a current-code on-chip number (incremental saves + the
+    # commit_evidence hook fire even on a mid-leg tunnel drop). Also the
+    # on-hardware verdict on the DenseNet buffer-vs-concat byte claim (#4).
+    # outer timeout > MICRO_INIT_CAP_S(300) + MICRO_TOTAL_CAP_S(600) so the
+    # script's own watchdogs, not the queue, decide a slow-but-live run
+    leg micro 1000 python scripts/tpu_micro_leg.py || continue
     leg bench 6600 env BENCH_TOTAL_BUDGET="${BENCH_TOTAL_BUDGET:-5400}" BENCH_CPU_INSURANCE=0 \
       sh -c 'python bench.py > artifacts/BENCH_local_tpu.json.tmp 2>/tmp/bench_full3.log && { head -c 200 artifacts/BENCH_local_tpu.json.tmp | grep -q "\"backend\": \"tpu\"" && mv artifacts/BENCH_local_tpu.json.tmp artifacts/BENCH_local_tpu.json; }' \
       || continue

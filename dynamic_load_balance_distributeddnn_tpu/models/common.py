@@ -6,6 +6,38 @@ import math
 
 import flax.linen as nn
 
+_GN_EXTRA_FIELDS = None  # lazily-built [(field, default)] to check per call
+
+
+def _groupnorm_extra_fields():
+    """The nn.GroupNorm schema fields the Pallas kernel does NOT implement,
+    with their defaults — computed once; per-module checks are then a cheap
+    getattr/compare loop. Derived from the schema, not an enumerated list,
+    so a knob added by a future flax version is rejected rather than
+    silently ignored."""
+    global _GN_EXTRA_FIELDS
+    if _GN_EXTRA_FIELDS is None:
+        import dataclasses as _dc
+
+        supported = {"num_groups", "epsilon", "relu", "use_pallas_kernel",
+                     "parent", "name"}
+
+        def _default(spec):
+            if spec.default is not _dc.MISSING:
+                return spec.default
+            if spec.default_factory is not _dc.MISSING:
+                return spec.default_factory()
+            return _dc.MISSING  # required field: nothing to compare
+
+        _GN_EXTRA_FIELDS = [
+            (f, d)
+            for f, spec in nn.GroupNorm.__dataclass_fields__.items()
+            if f not in supported
+            and spec.init
+            and (d := _default(spec)) is not _dc.MISSING
+        ]
+    return _GN_EXTRA_FIELDS
+
 
 class GroupNorm(nn.GroupNorm):
     """``nn.GroupNorm`` with two compute-only extensions: an optional relu
@@ -32,44 +64,25 @@ class GroupNorm(nn.GroupNorm):
 
     @nn.compact
     def __call__(self, x):
+        # the Pallas kernel implements the default nn.GroupNorm configuration
+        # only (num_groups/epsilon/relu are the supported knobs); silently
+        # honoring any other inherited field in one branch but not the other
+        # would break the both-branches-identical contract. Checked in BOTH
+        # branches (ADVICE r4): a config the kernel can't honor must fail on
+        # the fallback path too, not first at trace time on the chip.
+        unsupported = [
+            f for f, d in _groupnorm_extra_fields() if getattr(self, f, None) != d
+        ]
+        if unsupported:
+            raise NotImplementedError(
+                "Pallas GroupNorm requires default nn.GroupNorm config; "
+                f"non-default: {unsupported}"
+            )
         if self.use_pallas_kernel:
             from dynamic_load_balance_distributeddnn_tpu.ops.pallas import (
                 fused_group_norm,
             )
 
-            # the kernel implements the default nn.GroupNorm configuration
-            # only (num_groups/epsilon/relu are the supported knobs);
-            # silently honoring any other inherited field in one branch but
-            # not the other would break the both-branches-identical
-            # contract. Derived from the schema, not an enumerated list, so
-            # a knob added by a future flax version is rejected rather than
-            # silently ignored.
-            import dataclasses as _dc
-
-            supported = {"num_groups", "epsilon", "relu", "use_pallas_kernel",
-                         "parent", "name"}
-            fields = nn.GroupNorm.__dataclass_fields__
-
-            def _default(spec):
-                if spec.default is not _dc.MISSING:
-                    return spec.default
-                if spec.default_factory is not _dc.MISSING:
-                    return spec.default_factory()
-                return _dc.MISSING  # required field: nothing to compare
-
-            unsupported = [
-                f
-                for f, spec in fields.items()
-                if f not in supported
-                and spec.init
-                and _default(spec) is not _dc.MISSING
-                and getattr(self, f, None) != _default(spec)
-            ]
-            if unsupported:
-                raise NotImplementedError(
-                    "Pallas GroupNorm requires default nn.GroupNorm config; "
-                    f"non-default: {unsupported}"
-                )
             c = x.shape[-1]
             scale = self.param("scale", nn.initializers.ones, (c,))
             bias = self.param("bias", nn.initializers.zeros, (c,))

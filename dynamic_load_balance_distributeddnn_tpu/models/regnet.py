@@ -6,12 +6,74 @@ Constructors X_200MF / X_400MF / Y_400MF mirror Net/RegNet.py:108-141;
 
 from __future__ import annotations
 
-from typing import Mapping
+import os
+from typing import Mapping, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from dynamic_load_balance_distributeddnn_tpu.models.common import group_norm
+
+
+class GroupedConv(nn.Module):
+    """3×3 grouped convolution with an optional per-group decomposition.
+
+    XLA:CPU pathologically compiles ``feature_group_count > 1`` convolutions
+    — a single RegNetY-400MF fwd+bwd jit was observed 77+ minutes into one
+    compile on the CPU tier (CHANGES_r04.md), while XLA:TPU compiles the
+    same graph in seconds. ``decompose=True`` emits ``groups`` plain convs
+    over channel slices instead — that IS the definition of grouped
+    convolution (each group is an independent conv), so the math is
+    unchanged and the parameter is the same single fused ``kernel`` of shape
+    ``(3, 3, in//groups, features)`` that ``nn.Conv(feature_group_count=g)``
+    would create; only the emitted HLO differs.
+
+    ``decompose=None`` (default) resolves at trace time: decompose iff the
+    backend is CPU, overridable with DBS_DECOMPOSE_GROUPED_CONV=0/1.
+    """
+
+    features: int
+    strides: int
+    groups: int
+    decompose: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        assert in_ch % self.groups == 0 and self.features % self.groups == 0
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (3, 3, in_ch // self.groups, self.features),
+        )
+        kernel = kernel.astype(x.dtype)
+        dec = self.decompose
+        if dec is None:
+            env = os.environ.get("DBS_DECOMPOSE_GROUPED_CONV", "")
+            if env in ("0", "1"):
+                dec = env == "1"
+            else:
+                dec = jax.default_backend() == "cpu"
+        dn = jax.lax.conv_dimension_numbers(x.shape, kernel.shape, ("NHWC", "HWIO", "NHWC"))
+        pad = ((1, 1), (1, 1))
+        strides = (self.strides, self.strides)
+        if not dec or self.groups == 1:
+            return jax.lax.conv_general_dilated(
+                x, kernel, strides, pad,
+                feature_group_count=self.groups, dimension_numbers=dn,
+            )
+        in_g = in_ch // self.groups
+        out_g = self.features // self.groups
+        outs = [
+            jax.lax.conv_general_dilated(
+                x[..., g * in_g : (g + 1) * in_g],
+                kernel[..., g * out_g : (g + 1) * out_g],
+                strides, pad, dimension_numbers=dn,
+            )
+            for g in range(self.groups)
+        ]
+        return jnp.concatenate(outs, axis=-1)
 
 
 class SE(nn.Module):
@@ -43,14 +105,7 @@ class RegNetBlock(nn.Module):
 
         out = nn.Conv(w_b, (1, 1), use_bias=False)(x)
         out = group_norm(w_b, relu=True)(out)
-        out = nn.Conv(
-            w_b,
-            (3, 3),
-            strides=self.stride,
-            padding=1,
-            feature_group_count=num_groups,
-            use_bias=False,
-        )(out)
+        out = GroupedConv(features=w_b, strides=self.stride, groups=num_groups)(out)
         out = group_norm(w_b, relu=True)(out)
         if self.se_ratio > 0:
             out = SE(se_planes=int(round(w_in * self.se_ratio)))(out)

@@ -176,6 +176,12 @@ class Trainer:
         self.recorder.stamp_data_source(
             self.bundle if self.bundle is not None else getattr(self, "corpus", None)
         )
+        # Wall-definition provenance (ADVICE r4): since round 4, epoch walls
+        # (and examples_per_s/MFU derived from them) EXCLUDE standalone probe
+        # steps on every path; pre-round-4 artifacts include them. Stamped so
+        # cross-round comparisons can detect the definition boundary instead
+        # of silently mixing the two.
+        self.recorder.meta["wall_excludes_probes"] = True
         # induced-straggler provenance: lets offline tooling compute the
         # ideal equilibrium partition (share_i ∝ 1/f_i) and report the
         # balancer-quality convergence metric (BASELINE.md §protocol)

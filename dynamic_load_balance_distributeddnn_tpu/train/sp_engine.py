@@ -120,6 +120,10 @@ class SeqParallelLMTrainer:
         self._update = update
         self.recorder = MetricsRecorder()
         self.recorder.stamp_data_source(self.corpus)
+        # SP walls never contained standalone probe steps (the SP engine has
+        # no re-probe machinery); stamped so its artifacts carry the same
+        # wall-definition schema as the vision/LM engines (ADVICE r4)
+        self.recorder.meta["wall_excludes_probes"] = True
         if cfg.straggler:
             self.recorder.meta["straggler_factors"] = [
                 float(f) for f in cfg.straggler_factors()

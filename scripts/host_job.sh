@@ -15,11 +15,28 @@ PID=$!
 # wait for the child to become its own group leader — group signals sent
 # before setsid(2) completes would silently miss (ESRCH), letting the job
 # run unthrottled through a TPU leg or escape the exit cleanup
+MATCHED=0
 for _ in $(seq 1 50); do
-  [ "$(ps -o pgid= -p "$PID" 2>/dev/null | tr -d ' ')" = "$PID" ] && break
+  if [ "$(ps -o pgid= -p "$PID" 2>/dev/null | tr -d ' ')" = "$PID" ]; then MATCHED=1; break; fi
   kill -0 "$PID" 2>/dev/null || break
   sleep 0.1
 done
+if [ "$MATCHED" != 1 ]; then
+  if ! kill -0 "$PID" 2>/dev/null; then
+    # child already finished inside the poll window — nothing left to
+    # monitor; propagate its real exit status instead of misdiagnosing
+    wait "$PID"; exit $?
+  fi
+  # If the shell child was already a group leader, setsid(1) forks and $!
+  # is a short-lived intermediate — group signals would target the wrong
+  # (dead) pgid while the real job runs unthrottled through TPU legs.
+  # Fail loudly instead of silently monitoring nothing.
+  echo "[host_job] ERROR: child $PID never became its own process-group leader;" >&2
+  echo "[host_job] refusing to monitor a job I cannot pause. (If the wrapper" >&2
+  echo "[host_job] itself was SIGKILLed while paused, run: kill -CONT -- -<pgid>)" >&2
+  kill -- "-$PID" 2>/dev/null; kill "$PID" 2>/dev/null
+  exit 70
+fi
 # a stopped process ignores TERM until resumed — CONT first on exit
 trap 'kill -CONT -- "-$PID" 2>/dev/null; kill -- "-$PID" 2>/dev/null' EXIT
 PAUSED=0

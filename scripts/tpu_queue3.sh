@@ -24,8 +24,11 @@ trap 'rm -f .tpu_busy' EXIT
 commit_evidence () {
   git add -A artifacts/ 2>/dev/null
   if ! git diff --cached --quiet -- artifacts/ 2>/dev/null; then
-    git commit -q -m "tpu queue: on-chip evidence ($1, $(date -u +%H:%M:%SZ))" -- artifacts/ || true
-    echo "[queue3] committed evidence after $1"
+    if git commit -q -m "tpu queue: on-chip evidence ($1, $(date -u +%H:%M:%SZ))" -- artifacts/; then
+      echo "[queue3] committed evidence after $1"
+    else
+      echo "[queue3] WARNING: evidence commit FAILED after $1 (rc=$?) — artifacts staged but NOT committed" >&2
+    fi
   fi
 }
 

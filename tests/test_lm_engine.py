@@ -62,3 +62,34 @@ def test_lm_partition_shifts(tiny_corpus, tmp_path):
     final = np.array(rec.data["partition"][-1])
     assert final[0] < 0.22  # equilibrium 1/7 ~ 0.143 for 2:1 among 4
     assert final.sum() == pytest.approx(1.0)
+
+
+def test_lm_probe_accounting_matches_vision_contract(tiny_corpus, tmp_path):
+    """VERDICT r4 #7: the r4 probe-wall exclusion must hold on the LM path
+    too — probe_time is nonzero exactly on re-probe epochs, walls exclude
+    that cost, and the artifact carries the wall-definition stamp."""
+    tr = LMTrainer(
+        lm_cfg(tmp_path, epoch_size=3),
+        bundle=tiny_corpus,
+        injector=StaticStragglerInjector([2.0, 1.0, 1.0, 1.0], mode="virtual"),
+        log_to_file=False,
+    )
+    probed = []
+    orig = tr._probe_workers
+
+    def spy(plan, data, faults, epoch, **kw):
+        probed.append(epoch)
+        return orig(plan, data, faults, epoch, **kw)
+
+    tr._probe_workers = spy
+    walls = [tr.run_epoch(e)["epoch_wall"] for e in range(3)]
+    rec = tr.recorder.data.get("probe_time", [])
+    assert len(rec) == 3
+    for e in range(3):
+        if e in probed:
+            assert rec[e] > 0, (e, rec, probed)
+        else:
+            assert rec[e] == 0, (e, rec, probed)
+    assert tr.total_probe_s == pytest.approx(sum(rec), rel=1e-6)
+    assert tr.total_wallclock == pytest.approx(sum(walls), rel=1e-6)
+    assert tr.recorder.meta.get("wall_excludes_probes") is True

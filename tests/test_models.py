@@ -131,3 +131,29 @@ def test_transformer_flash_attention_variant():
     tokens2 = tokens.at[:, -1].set((tokens[:, -1] + 1) % 50)
     out2 = spec.module.apply(params, tokens2, train=False)
     np.testing.assert_allclose(out[:, :-1], out2[:, :-1], atol=1e-5)
+
+
+def test_grouped_conv_decompose_matches_grouped():
+    """GroupedConv's per-group decomposition (the XLA:CPU compile-pathology
+    workaround, models/regnet.py) is numerically the fused grouped conv:
+    same single kernel param, same output to fp tolerance, fwd and grad."""
+    from dynamic_load_balance_distributeddnn_tpu.models.regnet import GroupedConv
+
+    m_fused = GroupedConv(features=32, strides=2, groups=4, decompose=False)
+    m_dec = GroupedConv(features=32, strides=2, groups=4, decompose=True)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 16, 16), jnp.float32)
+    p = m_fused.init(jax.random.PRNGKey(0), x)
+    assert jax.tree_util.tree_structure(p) == jax.tree_util.tree_structure(
+        m_dec.init(jax.random.PRNGKey(0), x)
+    )
+    y1 = m_fused.apply(p, x)
+    y2 = m_dec.apply(p, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-5, rtol=1e-5)
+
+    def loss(params, mod):
+        return jnp.sum(mod.apply(params, x) ** 2)
+
+    g1 = jax.grad(loss)(p, m_fused)
+    g2 = jax.grad(loss)(p, m_dec)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)

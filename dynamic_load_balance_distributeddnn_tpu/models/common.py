@@ -75,7 +75,10 @@ class GroupNorm(nn.GroupNorm):
         ]
         if unsupported:
             raise NotImplementedError(
-                "Pallas GroupNorm requires default nn.GroupNorm config; "
+                "this GroupNorm supports only the default nn.GroupNorm config "
+                "(num_groups/epsilon/relu are the knobs): the Pallas kernel "
+                "implements exactly that, and the fallback branch rejects the "
+                "same configs so behavior cannot differ between branches; "
                 f"non-default: {unsupported}"
             )
         if self.use_pallas_kernel:

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-5 CPU-tier measurement chain (VERDICT r4 next #2/#3/#6/#7):
+# regenerate the FULL 5-config acceptance matrix with round-5 code in ONE
+# directory — no more cross-round archaeology. Ordered by marginal value so
+# an interrupted chain still lands the important rows first:
+#   1. c1  mnistnet ws2 [3,1]        12 ep  (parity anchor, ~3 min)
+#   2. c4  RegNetY-400MF ws8 [3,2,1×6] 4 ep (FIRST-EVER RegNet acceptance
+#          row — unblocked by the grouped-conv decomposition)
+#   3. c3  DenseNet-121 ws4 [3,1,1,1]  4 ep (north-star config)
+#   4. c5  Transformer LM ws4 [3,1,1,1] 6 ep (re-measured under the r4
+#          probe-wall fix; LM probe accounting)
+#   5. c2  ResNet-18 ws4 balanced      6 ep (elastic topology on CPU)
+# then emits ONE AB_TABLE.md for the whole matrix with provenance.
+#
+# Every leg runs under host_job.sh so the TPU queue's on-chip legs pause it
+# (.tpu_busy) instead of getting poisoned by host contention. All legs are
+# sentinel-idempotent: rerunning the chain resumes where it stopped.
+cd "$(dirname "$0")/.."
+set -u
+OUT=artifacts/acceptance_cpu_small_r5
+mkdir -p "$OUT"
+
+leg () {  # leg <env...> -- <desc>
+  local desc="${@: -1}"
+  echo "[r5_chain] === $desc ($(date -u +%H:%M:%S)) ===" >> /tmp/r5_chain.log
+  env "${@:1:$#-2}" bash scripts/host_job.sh \
+    python scripts/gen_statis.py --out_dir "$OUT" >> /tmp/r5_chain.log 2>&1
+  echo "[r5_chain] $desc rc=$? ($(date -u +%H:%M:%S))" >> /tmp/r5_chain.log
+}
+
+leg STATIS_CPU=1 STATIS_ONLY=c1_mnistnet STATIS_NTRAIN=2048 STATIS_EPOCHS=12 -- "c1 mnistnet 12ep"
+leg STATIS_CPU=1 STATIS_ONLY=c4_regnet_ws8 STATIS_NTRAIN=2048 STATIS_EPOCHS=4 STATIS_VISION_B=256 -- "c4 regnet ws8 4ep"
+leg STATIS_CPU=1 STATIS_ONLY=c3_densenet STATIS_NTRAIN=2048 STATIS_EPOCHS=4 -- "c3 densenet 4ep"
+leg STATIS_CPU=1 STATIS_ONLY=c5_transformer STATIS_LM_NTRAIN=120000 STATIS_EPOCHS=6 -- "c5 transformer 6ep"
+leg STATIS_CPU=1 STATIS_ONLY=c2_resnet18 STATIS_NTRAIN=2048 STATIS_EPOCHS=6 STATIS_FORCE_ELASTIC=1 -- "c2 resnet18 6ep"
+
+python scripts/summarize_statis.py "$OUT/statis" --markdown "$OUT/AB_TABLE.md" \
+  >> /tmp/r5_chain.log 2>&1
+{
+  echo ""
+  echo "Provenance: round-5 code ($(git rev-parse --short HEAD)), CPU tier"
+  echo "(1-core box, 8-virtual-device mesh — the reference's gloo-on-localhost"
+  echo "debug analogue), synthetic stand-in data (zero-egress env), seeds"
+  echo "paired across arms (1234), walls exclude probe cost"
+  echo "(wall_excludes_probes stamp). Scales: vision n_train=2048 (c4 B=256),"
+  echo "LM 120k tokens. Epochs: c1=12, c3/c4=4, c2/c5=6."
+} >> "$OUT/AB_TABLE.md"
+echo "[r5_chain] done at $(date -u +%H:%M:%S)" >> /tmp/r5_chain.log

@@ -41,9 +41,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "./.jax_cache")
 
 FORCE_CPU = os.environ.get("MICRO_CPU", "") == "1"
+MICRO_MODEL = os.environ.get("MICRO_MODEL", "densenet")
+if MICRO_MODEL not in ("densenet", "regnet"):
+    # a typo'd leg must not silently bench the wrong model and commit its
+    # numbers under an existing artifact name
+    sys.stderr.write(f"[micro_leg] unknown MICRO_MODEL={MICRO_MODEL!r}\n")
+    sys.exit(2)
+_STEM = "REGNET_COMPILE" if MICRO_MODEL == "regnet" else "STEPTIME"
 OUT = os.environ.get(
     "MICRO_OUT",
-    os.path.join("artifacts", "STEPTIME_cpu_plumbing.json" if FORCE_CPU else "STEPTIME_tpu.json"),
+    os.path.join(
+        "artifacts", f"{_STEM}_cpu_plumbing.json" if FORCE_CPU else f"{_STEM}_tpu.json"
+    ),
 )
 RESULT: dict = {"variants": {}}
 
@@ -111,14 +120,35 @@ def main() -> int:
     from dynamic_load_balance_distributeddnn_tpu.models.densenet import DenseNet, DenseNet121
     from dynamic_load_balance_distributeddnn_tpu.obs.flops import chip_peak_flops
 
-    if FORCE_CPU:
+    if MICRO_MODEL == "regnet":
+        # VERDICT r4 next #3(c): prove the FUSED grouped conv (the thing
+        # XLA:CPU cannot compile) compiles in seconds on the chip. One
+        # variant, decompose forced off on chip; CPU plumbing keeps the
+        # decomposition (the fused grouped conv is exactly the XLA:CPU
+        # pathology) and the variant name says which one actually ran.
+        from dynamic_load_balance_distributeddnn_tpu.models import build_model
+
+        B = int(os.environ.get("MICRO_B", 16 if FORCE_CPU else 512))
+        reps = int(os.environ.get("MICRO_REPS", 3 if FORCE_CPU else 20))
+        if FORCE_CPU:
+            variants = [("decomposed_grouped", None)]
+            RESULT["decompose_grouped"] = True
+        else:
+            variants = [("fused_grouped", None)]
+            os.environ["DBS_DECOMPOSE_GROUPED_CONV"] = "0"
+            RESULT["decompose_grouped"] = False
+        mk = lambda _: build_model("regnet", num_classes=10).module  # noqa: E731
+        RESULT["model"] = "regnety_400mf"
+    elif FORCE_CPU:
         B = int(os.environ.get("MICRO_B", 16))
         reps = int(os.environ.get("MICRO_REPS", 3))
+        variants = [("buffer", True), ("concat", False)]
         mk = lambda ub: DenseNet((2, 2), growth_rate=12, use_buffer=ub)  # noqa: E731
         RESULT["model"] = "densenet_tiny_2x2_g12"
     else:
         B = int(os.environ.get("MICRO_B", 512))
         reps = int(os.environ.get("MICRO_REPS", 20))
+        variants = [("buffer", True), ("concat", False)]
         mk = lambda ub: DenseNet121(use_buffer=ub)  # noqa: E731
         RESULT["model"] = "densenet121"
     RESULT["global_batch"] = B
@@ -151,7 +181,7 @@ def main() -> int:
 
         return step
 
-    for name, ub in (("buffer", True), ("concat", False)):
+    for name, ub in variants:
         t_sec = RESULT["variants"][name] = {}
         try:
             model = mk(ub)

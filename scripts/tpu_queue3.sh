@@ -68,7 +68,7 @@ leg () {  # leg <name> <timeout_s> <cmd...>
 }
 
 all_done () {
-  for n in micro bench mfu flash kernels statis precision statis_c5; do
+  for n in micro micro_regnet bench mfu flash kernels statis precision statis_c5; do
     [ -f "$STAMPS/$n.done" ] || [ -f "$STAMPS/$n.gaveup" ] || return 1
   done
   return 0
@@ -92,6 +92,9 @@ while true; do
     # outer timeout > MICRO_INIT_CAP_S(300) + MICRO_TOTAL_CAP_S(600) so the
     # script's own watchdogs, not the queue, decide a slow-but-live run
     leg micro 1000 python scripts/tpu_micro_leg.py || continue
+    # VERDICT r4 #3(c): the fused grouped conv (XLA:CPU's pathology) must be
+    # shown compiling in seconds on the chip — one variant, ~1 compile
+    leg micro_regnet 1000 env MICRO_MODEL=regnet python scripts/tpu_micro_leg.py || continue
     leg bench 6600 env BENCH_TOTAL_BUDGET="${BENCH_TOTAL_BUDGET:-5400}" BENCH_CPU_INSURANCE=0 \
       sh -c 'python bench.py > artifacts/BENCH_local_tpu.json.tmp 2>/tmp/bench_full3.log && { head -c 200 artifacts/BENCH_local_tpu.json.tmp | grep -q "\"backend\": \"tpu\"" && mv artifacts/BENCH_local_tpu.json.tmp artifacts/BENCH_local_tpu.json; }' \
       || continue

@@ -86,6 +86,15 @@ def main() -> int:
     ap.add_argument("--out_dir", default="artifacts/acceptance")
     ns = ap.parse_args()
 
+    seed = os.environ.get("STATIS_SEED")
+    if seed:
+        # seed is NOT part of Config.base_filename(), so sentinels and
+        # recorder artifacts of different seeds would collide in one
+        # out_dir (first-seed sentinels silently skip the second seed's
+        # runs; cleared sentinels overwrite its artifacts). Nest per seed
+        # so collisions are structurally impossible.
+        ns.out_dir = os.path.join(ns.out_dir, f"seed{seed}")
+
     import jax
 
     if os.environ.get("STATIS_CPU") == "1":
@@ -169,8 +178,9 @@ def main() -> int:
             if os.environ.get("STATIS_ARM_ORDER") == "false_first"
             else ("true", "false")
         )
+        seed = os.environ.get("STATIS_SEED")  # second-seed parity pairs
         for dbs in arm_order:
-            args = base + [
+            args = base + (["--seed", seed] if seed else []) + [
                 "-dbs", dbs,
                 "-e", str(EPOCHS),
                 "--n_train", str(n_train),

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-5 tail: runs after r5_cpu_chain.sh finishes (watches its log for
+# the done marker). Two legs:
+#   1. second-seed c1 pair (seed 4321, 12 ep) — quantifies the cross-seed
+#      noise band behind the accuracy-parity tolerance (VERDICT r4 #6).
+#      Config filenames don't encode the seed, so the pair gets its own
+#      subdir (seed4321/) to keep sentinels and artifacts distinct.
+#   2. CPU-insurance bench with round-5 code (the r4 protocol:
+#      reduced-scale DenseNet A/B on the CPU mesh, partials promoted on
+#      success only).
+cd "$(dirname "$0")/.."
+set -u
+
+while ! grep -q "\[r5_chain\] done" /tmp/r5_chain.log 2>/dev/null; do
+  sleep 60
+done
+
+OUT=artifacts/acceptance_cpu_small_r5
+# gen_statis nests per-seed (out_dir/seed4321) so the pair can't collide
+# with the seed-1234 matrix
+STATIS_CPU=1 STATIS_ONLY=c1_mnistnet STATIS_NTRAIN=2048 STATIS_EPOCHS=12 \
+  STATIS_SEED=4321 bash scripts/host_job.sh \
+  python scripts/gen_statis.py --out_dir "$OUT" >> /tmp/r5_tail.log 2>&1
+python scripts/summarize_statis.py "$OUT/seed4321/statis" >> /tmp/r5_tail.log 2>&1
+
+BENCH_FORCE_CPU=1 BENCH_CPU_NTRAIN=2048 BENCH_EPOCHS=7 \
+  BENCH_PARTIAL_PATH=artifacts/.bench_partial_cpu_r5.json \
+  BENCH_TOTAL_BUDGET=2400 \
+  bash scripts/host_job.sh sh -c \
+  'python bench.py > artifacts/.BENCH_cpu_insurance_r5.tmp 2>/tmp/bench_r5_cpu.log \
+     && mv artifacts/.BENCH_cpu_insurance_r5.tmp artifacts/BENCH_cpu_insurance_r5.json' \
+  >> /tmp/r5_tail.log 2>&1
+
+echo "[r5_tail] done at $(date -u +%H:%M:%S)" >> /tmp/r5_tail.log

@@ -94,6 +94,11 @@ def main() -> int:
         # runs; cleared sentinels overwrite its artifacts). Nest per seed
         # so collisions are structurally impossible.
         ns.out_dir = os.path.join(ns.out_dir, f"seed{seed}")
+    if os.environ.get("STATIS_GPU_MAP"):
+        # same collision hazard: the device map is not config-encoded
+        ns.out_dir = os.path.join(
+            ns.out_dir, "gpumap" + os.environ["STATIS_GPU_MAP"].replace(",", "")
+        )
 
     import jax
 
@@ -128,6 +133,17 @@ def main() -> int:
     else:
         names = [n for n in CONFIGS if n not in optional]
     vision_b = os.environ.get("STATIS_VISION_B")  # reduced-scale CPU insurance
+    # STATIS_GPU_MAP: explicit worker->device map (the reference's -gpu
+    # 0,0,0,1 contention syntax). CPU-tier escape hatch: mapping all workers
+    # to one device keeps per-worker executables single-device — the
+    # 8-device SPMD compile of a decomposed-grouped-conv RegNet is an
+    # XLA:CPU compile blowup even though the same graph compiles in ~42 s
+    # per worker single-device. Applied ONLY to vision configs whose
+    # world_size equals the map length (it is a per-config escape hatch,
+    # not a global topology override), and the run nests into its own
+    # out_dir because the device map is not part of the config-encoded
+    # filenames (same collision hazard as STATIS_SEED above).
+    gpu_map = os.environ.get("STATIS_GPU_MAP")  # out_dir nesting done above
     # STATIS_FORCE_ELASTIC=1: for configs that would otherwise take a
     # whole-epoch fused/packed CNN scan (no straggler -> uniform fused plan,
     # i.e. c2), map two workers per device so both arms use the elastic
@@ -164,6 +180,14 @@ def main() -> int:
         if vision_b and name != "c5_transformer":
             bi = base.index("-b")
             base[bi + 1] = vision_b
+        if (
+            gpu_map
+            and "-gpu" not in base
+            and name != "c5_transformer"
+            and len(gpu_map.split(",")) == int(base[base.index("-ws") + 1])
+        ):
+            print(f"[gen_statis] {name}: applying STATIS_GPU_MAP={gpu_map}", flush=True)
+            base += ["-gpu", gpu_map]
         if force_elastic and "-gpu" not in base and "--straggler" not in base:
             ws = int(base[base.index("-ws") + 1])
             if ws >= 4:  # >=2 devices, >=2 workers/device: elastic, not packed

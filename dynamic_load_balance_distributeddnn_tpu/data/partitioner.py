@@ -135,9 +135,11 @@ def build_epoch_plan(
     num_steps = 0
     for rank, (owned, b) in enumerate(zip(parts, batch_sizes)):
         b = int(max(b, 1))
-        order = np.random.RandomState(seed * 1000003 + epoch * 9176 + rank).permutation(
-            len(owned)
-        )
+        # mod 2**32: RandomState seeds are uint32, and any run seed > ~4294
+        # would overflow the multiply (found by the seed-4321 parity pair)
+        order = np.random.RandomState(
+            (seed * 1000003 + epoch * 9176 + rank) % (2**32)
+        ).permutation(len(owned))
         visit = owned[order]
         steps = max(-(-len(visit) // b), 1)
         padded = -(-b // bucket) * bucket

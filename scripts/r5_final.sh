@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-5 final measurement sequence (after two c3 SIGABRTs in XLA:CPU's
+# 40 s collective-rendezvous timeout — DenseNet's ~130 s per-shard segments
+# mean any thread staggering at the 4-device all-reduce, e.g. from an
+# epoch-1 new-shape compile running concurrently, can blow the window):
+#   1. c3 with STATIS_GPU_MAP=0,0,0,0 — all 4 workers on ONE device, so
+#      the combine has no cross-device rendezvous at all. Same serialized
+#      1-core compute as every other CPU-tier row; topology recorded in
+#      the out_dir nesting + manifest args.
+#   2. seed-4321 c1 pair (the uint32 seed-overflow bug in the per-epoch
+#      shuffle is fixed).
+#   3. ONE merged AB_TABLE.md across both statis dirs.
+cd "$(dirname "$0")/.."
+set -u
+OUT=artifacts/acceptance_cpu_small_r5
+
+echo "[r5_final] === c3 densenet 4ep gpumap0000 ($(date -u +%H:%M:%S)) ===" >> /tmp/r5_chain.log
+STATIS_CPU=1 STATIS_ONLY=c3_densenet STATIS_NTRAIN=2048 STATIS_EPOCHS=4 \
+  STATIS_GPU_MAP=0,0,0,0 bash scripts/host_job.sh \
+  python scripts/gen_statis.py --out_dir "$OUT" >> /tmp/r5_chain.log 2>&1
+echo "[r5_final] c3 rc=$? ($(date -u +%H:%M:%S))" >> /tmp/r5_chain.log
+
+echo "[r5_final] === seed-4321 c1 ($(date -u +%H:%M:%S)) ===" >> /tmp/r5_chain.log
+STATIS_CPU=1 STATIS_ONLY=c1_mnistnet STATIS_NTRAIN=2048 STATIS_EPOCHS=12 \
+  STATIS_SEED=4321 bash scripts/host_job.sh \
+  python scripts/gen_statis.py --out_dir "$OUT" >> /tmp/r5_chain.log 2>&1
+echo "[r5_final] seed c1 rc=$? ($(date -u +%H:%M:%S))" >> /tmp/r5_chain.log
+
+python scripts/summarize_statis.py "$OUT/statis" "$OUT/gpumap0000/statis" \
+  --markdown "$OUT/AB_TABLE.md" >> /tmp/r5_chain.log 2>&1
+{
+  echo ""
+  echo "Provenance: round-5 code, CPU tier (1-core box; 8-virtual-device"
+  echo "mesh except the c3 row, which runs all 4 workers on one device —"
+  echo "XLA:CPU's 40 s collective-rendezvous termination timeout aborts"
+  echo "cross-device combines whose per-shard segments run ~130 s, see"
+  echo "gpumap0000/ nesting; same serialized 1-core compute either way),"
+  echo "synthetic stand-in data (zero-egress env), seeds paired across arms"
+  echo "(1234; cross-seed noise band: seed4321/ c1 pair), walls exclude"
+  echo "probe cost (wall_excludes_probes). Scales: vision n_train=2048"
+  echo "(c4 B=256), LM 120k tokens. Epochs: c1=12, c2/c3/c4/c5=4."
+} >> "$OUT/AB_TABLE.md"
+echo "[r5_final] done at $(date -u +%H:%M:%S)" >> /tmp/r5_chain.log

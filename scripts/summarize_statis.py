@@ -78,7 +78,15 @@ def main(argv):
     runs = {}
     for p in paths:
         try:
-            runs[os.path.basename(p)] = load(p)
+            # keyed by basename; a same-config artifact from a second dir
+            # (e.g. a gpumap/seed-nested variant of one config) must not
+            # silently shadow the first — disambiguate with the parent dir
+            key = os.path.basename(p)
+            parent = os.path.dirname(p)
+            while key in runs and parent:
+                key = f"{os.path.basename(parent)}/{key}"
+                parent = os.path.dirname(parent)
+            runs[key] = load(p)
         except Exception as e:
             print(f"skip {p}: {e}", file=sys.stderr)
     for name, d in runs.items():

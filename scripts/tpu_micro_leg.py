@@ -224,6 +224,29 @@ def main() -> int:
             t_sec["pipelined_step_ms"] = t_pipe * 1e3
             t_sec["examples_per_s"] = B / t_pipe
             t_sec["final_loss"] = float(loss)
+            # synced: per-step HOST READBACK of the loss scalar plus a final
+            # block, timed as one total wall. float(loss) cannot return until
+            # the device has produced the value, so this is immune to any
+            # block_until_ready quirk on experimental/tunneled platforms —
+            # the round-5 first on-chip run produced a buffer-variant
+            # blocking_step_ms that implied >500% of bf16 peak, which only a
+            # broken block (not physics) can explain. total_wall/reps is the
+            # trustworthy step time; per-step medians are kept for shape.
+            times2 = []
+            t_all0 = time.perf_counter()
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                p2, o2, loss = step(p2, o2, x, y)
+                _ = float(loss)
+                times2.append(time.perf_counter() - t0)
+            jax.block_until_ready(p2)
+            t_wall = (time.perf_counter() - t_all0) / reps
+            times2.sort()
+            t_sec["synced_step_ms_median"] = times2[len(times2) // 2] * 1e3
+            t_sec["synced_total_wall_ms_per_step"] = t_wall * 1e3
+            t_sec["synced_examples_per_s"] = B / t_wall
+            if t_sec.get("flops_per_step") and peak:
+                t_sec["step_mfu_synced"] = t_sec["flops_per_step"] / t_wall / peak
             f = t_sec["flops_per_step"]
             if f and peak:
                 t_sec["step_mfu_blocking"] = f / t_min / peak

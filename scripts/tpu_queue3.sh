@@ -89,12 +89,16 @@ while true; do
     # commits a current-code on-chip number (incremental saves + the
     # commit_evidence hook fire even on a mid-leg tunnel drop). Also the
     # on-hardware verdict on the DenseNet buffer-vs-concat byte claim (#4).
-    # outer timeout > MICRO_INIT_CAP_S(300) + MICRO_TOTAL_CAP_S(600) so the
-    # script's own watchdogs, not the queue, decide a slow-but-live run
-    leg micro 1000 python scripts/tpu_micro_leg.py || continue
+    # outer timeout > MICRO_INIT_CAP_S + MICRO_TOTAL_CAP_S so the script's
+    # own watchdogs, not the queue, decide a slow-but-live run. Round-5
+    # observation: the first DenseNet-121 B=512 compile over the axon tunnel
+    # exceeded the original 600 s total cap (watchdog fired, 0 variants
+    # landed), so the caps are sized for tunnel-compile latency now; the
+    # persistent ./.jax_cache makes retries and later legs cheap.
+    leg micro 4000 env MICRO_INIT_CAP_S=600 MICRO_TOTAL_CAP_S=3300 python scripts/tpu_micro_leg.py || continue
     # VERDICT r4 #3(c): the fused grouped conv (XLA:CPU's pathology) must be
     # shown compiling in seconds on the chip — one variant, ~1 compile
-    leg micro_regnet 1000 env MICRO_MODEL=regnet python scripts/tpu_micro_leg.py || continue
+    leg micro_regnet 2500 env MICRO_MODEL=regnet MICRO_INIT_CAP_S=600 MICRO_TOTAL_CAP_S=1800 python scripts/tpu_micro_leg.py || continue
     leg bench 6600 env BENCH_TOTAL_BUDGET="${BENCH_TOTAL_BUDGET:-5400}" BENCH_CPU_INSURANCE=0 \
       sh -c 'python bench.py > artifacts/BENCH_local_tpu.json.tmp 2>/tmp/bench_full3.log && { head -c 200 artifacts/BENCH_local_tpu.json.tmp | grep -q "\"backend\": \"tpu\"" && mv artifacts/BENCH_local_tpu.json.tmp artifacts/BENCH_local_tpu.json; }' \
       || continue

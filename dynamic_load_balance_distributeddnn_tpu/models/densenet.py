@@ -5,17 +5,26 @@ selects DenseNet-121 with growth 32 (dbs.py:353) — the model of the canonical
 README recipe and the benchmark north star.
 
 TPU note (the roofline lever, artifacts/ROOFLINE.md): DenseNet is
-bandwidth-bound on v5e, and the naive translation of the reference's
-``torch.cat([out, x], 1)`` per layer (Net/Densenet.py:20) re-materializes the
-whole growing feature map every layer — O(L²·g) concat traffic per dense
-block. Here each block instead pre-allocates its final-width buffer once and
-every layer writes only its ``growth_rate`` new channels into it with a
-static-offset slice update, which XLA aliases in place — O(L·g) write
-traffic. The buffer fills RIGHT-TO-LEFT so the live prefix ``buf[..., s:]``
-reads ``[out_{i-1}, ..., out_0, x]`` — exactly the channel order the nested
-reference concat produces, so the math (and GroupNorm's channel grouping) is
-unchanged. ``use_buffer=False`` keeps the literal concat for equivalence
-tests.
+bandwidth-bound on v5e. Two dense-block dataflows are provided, bitwise
+equivalent (pinned by test):
+
+- ``use_buffer=False`` (DEFAULT): the literal per-layer channel concat,
+  the reference shape (``torch.cat([out, x], 1)``, Net/Densenet.py:20).
+- ``use_buffer=True``: each block pre-allocates its final-width buffer and
+  every layer writes its ``growth_rate`` new channels with a static-offset
+  slice update, filling RIGHT-TO-LEFT so the live prefix ``buf[..., s:]``
+  reads ``[out_{i-1}, ..., out_0, x]`` — the channel order the nested
+  reference concat produces.
+
+The buffer variant was round 4's cost-model bet (−36% bytes on the XLA:CPU
+cost model at B=32/f32). **Measured on the chip it LOSES**: the round-5
+on-chip A/B (artifacts/STEPTIME_tpu.json, TPU v5e, DenseNet-121 B=512 bf16)
+shows XLA:TPU does NOT alias the ``buf.at[...].set`` chain — the TPU-backend
+cost model charges the buffer variant 93.7 GB/step vs concat's 78.3 GB
+(+20%), and RTT-corrected synced step times agree: buffer ≈129 ms/step vs
+concat ≈87 ms. XLA:TPU fuses the literal concat chain better than the
+hand-scheduled buffer fill — so the concat dataflow is the default and the
+buffer variant is kept as the measured counterexample + equivalence oracle.
 """
 
 from __future__ import annotations
@@ -66,7 +75,9 @@ class DenseNet(nn.Module):
     growth_rate: int = 12
     reduction: float = 0.5
     num_classes: int = 10
-    use_buffer: bool = True  # False: literal per-layer concat (test oracle)
+    # concat measured faster on TPU v5e (see module docstring); True keeps
+    # the round-4 buffer fill as an equivalence oracle / counterexample
+    use_buffer: bool = False
 
     def _dense_block(self, x, nblock: int):
         """One dense block; returns the full-width feature map equal to the

@@ -46,11 +46,22 @@ def test_cnn_families_exact_param_parity(name, nc, expect):
     assert n == expect, f"{name}: {n:,} params != reference {expect:,}"
 
 
+def test_densenet_default_is_concat():
+    """Round-5 on-chip verdict (artifacts/STEPTIME_tpu.json): the literal
+    concat dataflow beats the round-4 buffer fill on XLA:TPU (87 vs 129
+    ms/step, -20% bytes by the TPU cost model), so every default-built
+    DenseNet must run it."""
+    from dynamic_load_balance_distributeddnn_tpu.models.densenet import DenseNet121
+
+    assert DenseNet121().use_buffer is False
+
+
 def test_densenet_buffer_matches_concat():
-    """The dense block's pre-allocated right-to-left buffer (the roofline
-    byte cut, models/densenet.py docstring) is numerically the reference's
-    nested concat: same param tree, bitwise-equal forward, grads equal to
-    fp tolerance."""
+    """The dense block's pre-allocated right-to-left buffer (round 4's
+    byte-cut bet, kept as an equivalence oracle after the round-5 on-chip
+    measurement went to concat — models/densenet.py docstring) is
+    numerically the reference's nested concat: same param tree,
+    bitwise-equal forward, grads equal to fp tolerance."""
     from dynamic_load_balance_distributeddnn_tpu.models.densenet import DenseNet
 
     m_buf = DenseNet((3, 4), growth_rate=32, num_classes=10, use_buffer=True)

@@ -104,6 +104,25 @@ class Config:
     time_smoothing: float = 0.0        # EMA factor on the measured node-time
                                        # vector (0 = off, exact reference
                                        # semantics: raw last-epoch times)
+    probe_overhead_correction: bool = True
+                                       # subtract the per-device dispatch/sync
+                                       # overhead (measured on a tiny jitted
+                                       # op, the same blocking discipline as
+                                       # the probes) from standalone probe
+                                       # walls before they anchor the
+                                       # per-example cost model or the
+                                       # balancer signal. On local backends
+                                       # this is O(100us) and invisible; over
+                                       # a tunneled device (axon: ~66 ms RTT,
+                                       # artifacts/STEPTIME_tpu.json) an
+                                       # uncorrected anchor inflates the
+                                       # per-example cost ~4x, which oversizes
+                                       # compute-mode injection by the same
+                                       # factor (a 3:1 nominal profile lands
+                                       # ~9:1 in device terms). Paired
+                                       # measurements (iter-cost calibration)
+                                       # were already immune: the RTT cancels
+                                       # in their subtraction.
     probe_mode: str = "adaptive"       # "always": per-worker probe steps every
                                        # epoch (round-2 behavior; the reference
                                        # analogue, since it re-times every
@@ -379,6 +398,11 @@ def get_parser() -> argparse.ArgumentParser:
                    help="Stream the host data path in windows of N steps "
                         "(prefetch overlaps compute); 0 = materialize whole epochs.")
     p.add_argument("--time_smoothing", type=float, default=d.time_smoothing)
+    p.add_argument("--probe_overhead_correction", type=str2bool,
+                   default=d.probe_overhead_correction,
+                   help="Subtract measured per-device dispatch overhead from "
+                        "standalone probe walls (tunneled-device hygiene; "
+                        "negligible on local backends).")
     p.add_argument("--probe_mode", type=str, default=d.probe_mode,
                    choices=["adaptive", "always"],
                    help="adaptive: skip per-worker probe steps once the "

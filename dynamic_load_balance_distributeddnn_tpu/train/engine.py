@@ -1463,8 +1463,41 @@ class Trainer:
             jax.block_until_ready(aux)
             heartbeat()
 
+        # Dispatch-overhead floor (config.probe_overhead_correction): every
+        # blocking probe wall includes one dispatch+sync round trip that is
+        # NOT per-example device compute — O(100us) locally, ~66 ms over the
+        # axon tunnel (artifacts/STEPTIME_tpu.json round-5 measurement).
+        # Measure it per device with a tiny jitted op under BOTH sync
+        # disciplines a probe may hit (block_until_ready and a scalar
+        # readback) and take the MIN, so the correction can only be
+        # conservative; the subtraction below is additionally floored at 20%
+        # of the raw wall so a pathological overhead estimate can never
+        # zero out a real measurement.
+        ovh_by_dev: dict = {}
+        if getattr(cfg, "probe_overhead_correction", True):
+            tiny = jax.jit(lambda a: a + 1.0)
+            for d in topo.used_device_indices:
+                tx = jax.device_put(jnp.float32(0.0), topo.devices[d])
+                y = tiny(tx)
+                jax.block_until_ready(y)
+                float(y)  # compile + warm both sync paths
+                e_block = e_read = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(tiny(tx))
+                    e_block = min(e_block, time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    float(tiny(tx))
+                    e_read = min(e_read, time.perf_counter() - t0)
+                ovh_by_dev[d] = min(e_block, e_read)
+            self._probe_overhead_s = max(ovh_by_dev.values())
+            self.recorder.meta["probe_dispatch_overhead_s"] = round(
+                self._probe_overhead_s, 6
+            )
+
         def timed(d: int, args2):
-            """(min-over-reps blocking wall, last partial) of one probe step."""
+            """(min-over-reps blocking wall minus the device's dispatch
+            overhead, last partial) of one probe step."""
             dt, acc = float("inf"), None
             for _ in range(reps):
                 t0 = time.perf_counter()
@@ -1472,7 +1505,7 @@ class Trainer:
                 jax.block_until_ready(aux)
                 dt = min(dt, time.perf_counter() - t0)
             heartbeat()
-            return dt, acc
+            return max(dt - ovh_by_dev.get(d, 0.0), 0.2 * dt), acc
 
         lo, hi = self.rank_lo, self.rank_lo + self.ws_local
         init_epoch = bool(np.isnan(self.per_example_cost[lo:hi]).any())

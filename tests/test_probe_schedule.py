@@ -218,3 +218,33 @@ def test_straggler_profile_stamped_in_meta(bundle):
     )
     assert tr.recorder.meta["straggler_factors"] == [3.0, 1.0, 1.0, 1.0]
     assert tr.recorder.meta["fault_mode"] == "virtual"
+
+
+def test_probe_overhead_correction_recorded(bundle):
+    """config.probe_overhead_correction subtracts the measured per-device
+    dispatch overhead from standalone probe walls before they anchor the
+    per-example cost model. Over the axon tunnel that overhead is ~66 ms and
+    an uncorrected anchor oversizes compute-mode injection ~4x (round-5
+    on-chip finding, artifacts/AB_ANALYSIS.md); on CPU it is O(100us) and
+    the correction must be a no-op in magnitude but still instrumented."""
+    tr = Trainer(
+        _cfg(),
+        bundle=bundle,
+        injector=StaticStragglerInjector([3, 1, 1, 1], mode="virtual"),
+        log_to_file=False,
+    )
+    tr.run_epoch(0)
+    ovh = tr.recorder.meta.get("probe_dispatch_overhead_s")
+    assert ovh is not None and 0.0 <= ovh < 0.05
+    # the clean anchor must survive the subtraction (floored at 20% raw wall)
+    assert np.isfinite(tr.per_example_cost).all()
+    assert (tr.per_example_cost > 0).all()
+
+    off = Trainer(
+        _cfg(probe_overhead_correction=False),
+        bundle=bundle,
+        injector=StaticStragglerInjector([3, 1, 1, 1], mode="virtual"),
+        log_to_file=False,
+    )
+    off.run_epoch(0)
+    assert "probe_dispatch_overhead_s" not in off.recorder.meta

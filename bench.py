@@ -276,6 +276,21 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
         out["instr"][f"{arm}_iter_cost_us"] = (
             round(tr._iter_cost_s * 1e6, 3) if tr._iter_cost_s else None
         )
+        # per-epoch MODELED PARALLEL wall: max over workers of the epoch's
+        # per-worker compute seconds (probe-measured / cost-modeled,
+        # dispatch-overhead-corrected). On a real ws-chip deployment the
+        # epoch wall is this max — the frame the reference's multi-GPU
+        # numbers live in — while epoch_wall above serializes all workers
+        # through the one bench chip. Kept per epoch so _result_from can
+        # apply the same steady-window slicing as the serialized walls.
+        nt = tr.recorder.data.get("node_time") or []
+        out["instr"][f"{arm}_parallel_walls_s"] = [
+            round(float(max(v)), 4) if len(v) else None for v in nt
+        ]
+        if tr.recorder.meta.get("probe_dispatch_overhead_s") is not None:
+            out["instr"][f"{arm}_probe_dispatch_overhead_s"] = tr.recorder.meta[
+                "probe_dispatch_overhead_s"
+            ]
         _write_atomic(out_path, out)
 
     if os.environ.get("BENCH_CLEAN", "1") == "1" and len(resume.get("clean", [])) < 2:
@@ -400,6 +415,24 @@ def _result_from(partial) -> dict | None:
         "world_size": partial.get("world_size"),
         **partial.get("instr", {}),
     }
+    # Modeled-parallel A/B (see run_arms: max per-worker compute seconds per
+    # epoch, the ws-chip deployment frame — ceiling for [3,1,1,1] is
+    # (Σf/ws)/(1/Σ(1/f)·ws)... = 2.5x there, vs the serialized 1.25x above).
+    instr_all = partial.get("instr", {})
+    pwo, pwn = _steady(
+        instr_all.get("off_parallel_walls_s") or [],
+        instr_all.get("on_parallel_walls_s") or [],
+    )
+    so, sn = _stats([w for w in pwo if w]), _stats([w for w in pwn if w])
+    if so and sn and sn["median"] > 0:
+        detail["modeled_parallel"] = {
+            "off_steady": so,
+            "on_steady": sn,
+            "speedup_median": round(so["median"] / sn["median"], 4),
+            "note": "per-worker device-seconds maxima (probe-based), the "
+            "multi-chip deployment frame; the headline vs_baseline stays "
+            "in the measured serialized-wall frame",
+        }
     return {
         "metric": "densenet121_cifar10_ws4_3to1straggler_epoch_wallclock"
         if partial.get("backend") == "tpu"

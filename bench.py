@@ -417,7 +417,8 @@ def _result_from(partial) -> dict | None:
     }
     # Modeled-parallel A/B (see run_arms: max per-worker compute seconds per
     # epoch, the ws-chip deployment frame — ceiling for [3,1,1,1] is
-    # (Σf/ws)/(1/Σ(1/f)·ws)... = 2.5x there, vs the serialized 1.25x above).
+    # (max f/ws)/(1/Σ(1/f)) = 0.75/0.3 = 2.5x there, vs the serialized
+    # 1.25x above).
     instr_all = partial.get("instr", {})
     pwo, pwn = _steady(
         instr_all.get("off_parallel_walls_s") or [],

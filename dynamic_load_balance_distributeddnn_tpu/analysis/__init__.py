@@ -1,0 +1,40 @@
+"""graftlint: static + runtime correctness tooling for the TPU/JAX codebase.
+
+Two halves, one contract — keep the DBS loop's timing signal trustworthy and
+its XLA compile count bounded:
+
+* :mod:`.linter` / :mod:`.rules` — an AST linter with repo-specific rules
+  (G001-G005) for the structural perf bugs this repo has actually shipped:
+  jit-in-hot-scope recompile churn, un-synced walls around async dispatches,
+  off-ladder batch shapes, tracer coercion, use-after-donation.
+* :mod:`.guards` — runtime guards hooked on ``jax.monitoring`` compile
+  events: :func:`~.guards.compile_budget` asserts a compile bound over a code
+  region cheaply, and :class:`~.guards.CompileTracker` lets the engine log
+  unexpected steady-state recompiles in production runs.
+"""
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.guards import (
+    CompileBudgetExceeded,
+    CompileTracker,
+    compile_budget,
+    compile_count,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.linter import (
+    Finding,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.rules import RULES
+
+__all__ = [
+    "CompileBudgetExceeded",
+    "CompileTracker",
+    "compile_budget",
+    "compile_count",
+    "Finding",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "RULES",
+]

@@ -1,0 +1,201 @@
+"""Shared AST plumbing for the graftlint rules.
+
+Everything here is deliberately *syntactic*: graftlint runs on one file at a
+time with no import resolution, so the helpers answer questions like "does
+this call spell a jax.jit construction" or "which names in this function were
+assigned from expressions mentioning the bucket ladder" — the level of
+precision the repo-specific rules need, no more.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.experimental.pjit.pjit`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+# Spellings that construct a (p)jit-wrapped callable. The repo imports jax
+# plainly everywhere, so matching the dotted tail is enough.
+_JIT_TAILS = ("jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit")
+
+
+def is_jit_construction(node: ast.Call) -> bool:
+    """``jax.jit(...)`` / ``pjit(...)``, or ``functools.partial(jax.jit, ...)``."""
+    name = call_name(node)
+    if name in _JIT_TAILS:
+        return True
+    if name in ("functools.partial", "partial") and node.args:
+        return dotted_name(node.args[0]) in _JIT_TAILS
+    return False
+
+
+def jit_kwarg(node: ast.Call, key: str) -> Optional[ast.expr]:
+    """A keyword of the jit construction, looking through functools.partial."""
+    for kw in node.keywords:
+        if kw.arg == key:
+            return kw.value
+    return None
+
+
+def literal_int_tuple(node: Optional[ast.expr]) -> Optional[Tuple[int, ...]]:
+    """Evaluate ``donate_argnums=(0, 1)`` / ``=1``-style literals."""
+    if node is None:
+        return None
+    try:
+        val = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+    if isinstance(val, int):
+        return (val,)
+    if isinstance(val, (tuple, list)) and all(isinstance(v, int) for v in val):
+        return tuple(val)
+    return None
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def identifiers_in(node: ast.AST) -> Set[str]:
+    """Names AND attribute components — catches ``cfg.batch_size`` as
+    ``batch_size`` and ``self._cap_b`` as ``_cap_b``."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+class ScopeInfo:
+    """One function (or lambda) scope plus its chain of enclosing scopes."""
+
+    def __init__(self, node: ast.AST, parent: Optional["ScopeInfo"]):
+        self.node = node
+        self.parent = parent
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+    def chain(self) -> Iterator["ScopeInfo"]:
+        s: Optional[ScopeInfo] = self
+        while s is not None:
+            yield s
+            s = s.parent
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing_functions(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> List[ast.AST]:
+    """Innermost-first FunctionDef/AsyncFunctionDef/Lambda chain above node."""
+    out: List[ast.AST] = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            out.append(cur)
+        cur = parents.get(cur)
+    return out
+
+
+def enclosing_loop(
+    node: ast.AST,
+    parents: Dict[ast.AST, ast.AST],
+    stop_at: Optional[ast.AST] = None,
+) -> Optional[ast.AST]:
+    """Nearest For/While above ``node`` without crossing ``stop_at``
+    (a function boundary): a jit built inside a loop recompiles per
+    iteration even when the function itself is setup-scoped."""
+    cur = parents.get(node)
+    while cur is not None and cur is not stop_at:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return None
+        cur = parents.get(cur)
+    return None
+
+
+def decorator_names(fn: ast.AST) -> List[str]:
+    out: List[str] = []
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if name:
+            out.append(name)
+        # functools.partial(jax.jit, ...) as a decorator: surface the inner
+        # callable too, so jit-decorated defs are recognizable
+        if (
+            isinstance(dec, ast.Call)
+            and dotted_name(dec.func) in ("functools.partial", "partial")
+            and dec.args
+        ):
+            inner = dotted_name(dec.args[0])
+            if inner:
+                out.append(inner)
+    return out
+
+
+def suppressed_rules(source_line: str) -> Set[str]:
+    """``# graftlint: disable=G001,G004`` on the flagged line."""
+    marker = "graftlint:"
+    idx = source_line.find(marker)
+    if idx < 0:
+        return set()
+    rest = source_line[idx + len(marker):]
+    if "disable=" not in rest:
+        return set()
+    parts = rest.split("disable=", 1)[1].split()
+    codes = parts[0] if parts else ""
+    return {c.strip() for c in codes.split(",") if c.strip()}
+
+
+def assign_targets(stmt: ast.stmt) -> Set[str]:
+    """Plain-Name targets this statement (re)binds."""
+    out: Set[str] = set()
+
+    def collect(t: ast.expr) -> None:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect(e)
+        elif isinstance(t, ast.Starred):
+            collect(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            collect(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        collect(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        collect(stmt.target)
+    elif isinstance(stmt, ast.With):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                collect(item.optional_vars)
+    return out

@@ -1,0 +1,88 @@
+"""``graftlint`` console entry point.
+
+Usage::
+
+    graftlint dynamic_load_balance_distributeddnn_tpu bench.py
+    graftlint --select G001,G003 train/engine.py
+    graftlint --list-rules
+
+Exit status: 0 when clean, 1 when findings, 2 on usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.linter import lint_paths
+from dynamic_load_balance_distributeddnn_tpu.analysis.rules import RULES
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description=(
+            "TPU/JAX correctness linter for this repo: jit-in-hot-scope "
+            "(G001), unsynced walls (G002), off-ladder batch shapes (G003), "
+            "tracer coercion (G004), use-after-donation (G005)."
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files and/or package directories to lint (recursive)",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        metavar="CODES",
+        help="comma-separated rule codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    parser.add_argument(
+        "-q",
+        "--quiet",
+        action="store_true",
+        help="suppress the per-finding fix hints",
+    )
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for code, rule in sorted(RULES.items()):
+            print(f"{code}  {rule.summary}")
+        return 0
+    if not args.paths:
+        print("graftlint: no paths given (try --help)", file=sys.stderr)
+        return 2
+    select = None
+    if args.select:
+        select = [c.strip() for c in args.select.split(",") if c.strip()]
+        unknown = sorted(set(select) - set(RULES))
+        if unknown:
+            print(f"graftlint: unknown rule codes {unknown}", file=sys.stderr)
+            return 2
+    try:
+        findings = lint_paths(args.paths, select=select)
+    except (OSError, SyntaxError) as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+    for f in findings:
+        if args.quiet:
+            print(f"{f.path}:{f.line}:{f.col}: {f.code} {f.message}")
+        else:
+            print(f.format())
+    n = len(findings)
+    print(f"graftlint: {n} finding{'s' if n != 1 else ''}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

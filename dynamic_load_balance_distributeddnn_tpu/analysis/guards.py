@@ -1,0 +1,175 @@
+"""Runtime compile/sync guards built on ``jax.monitoring``.
+
+JAX records a ``/jax/core/compile/backend_compile_duration`` event for every
+actual XLA backend compile (cache hits don't fire it). One process-wide
+listener fans those events out to:
+
+* a global monotone counter (:func:`compile_count`) — cheap deltas anywhere;
+* :func:`compile_budget` — a context manager asserting "this region compiles
+  at most N programs", which lets the bucket-ladder contract of
+  tests/test_compile_discipline.py be checked in the fast tier instead of
+  only by the @slow e2e run;
+* :class:`CompileTracker` — a drainable per-consumer counter the engine uses
+  to log unexpected steady-state recompiles in production runs (an off-ladder
+  shape sneaking into a timed epoch is invisible in the wall on a fast chip
+  but poisons the DBS time signal; see graftlint G003).
+
+The listener registers lazily on first use and is never unregistered
+(jax.monitoring has no public unregister; an idle listener costs one function
+call per compile, i.e. nothing).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional
+
+_COMPILE_EVENT_PREFIX = "/jax/core/compile/backend_compile"
+
+_lock = threading.Lock()
+_installed = False
+_total_compiles = 0
+_active_budgets: List["CompileBudget"] = []
+# Weak registry: consumers (one tracker per Trainer) drop out when their
+# owner is garbage-collected, so a process that builds many engines (bench
+# arms, the test suite) never accumulates stale fan-out targets.
+_trackers: "weakref.WeakSet[CompileTracker]" = weakref.WeakSet()
+
+
+def _on_event(event: str, duration: float = 0.0, **_kw) -> None:
+    global _total_compiles
+    if not event.startswith(_COMPILE_EVENT_PREFIX):
+        return
+    with _lock:
+        _total_compiles += 1
+        for budget in _active_budgets:
+            budget.count += 1
+        for tracker in _trackers:
+            tracker._pending += 1
+
+
+def _ensure_listener() -> None:
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        # register under the lock and mark installed only on success: a
+        # guard that silently failed to hook the listener would report
+        # green (0 compiles) forever after. _on_event cannot fire (and
+        # re-take the lock) until registration completes.
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+        _installed = True
+
+
+def compile_count() -> int:
+    """Total XLA backend compiles observed since the listener was installed.
+    Call once early (e.g. at trainer init) if you intend to diff against it —
+    compiles before installation are not counted."""
+    _ensure_listener()
+    with _lock:
+        return _total_compiles
+
+
+class CompileBudgetExceeded(RuntimeError):
+    def __init__(self, label: str, count: int, max_compiles: int):
+        self.label = label
+        self.count = count
+        self.max_compiles = max_compiles
+        super().__init__(
+            f"compile budget exceeded in {label!r}: {count} XLA backend "
+            f"compiles > budget {max_compiles} — an input shape fell off the "
+            "bucket ladder or a jit wrapper was rebuilt (graftlint G001/G003)"
+        )
+
+
+@dataclass(eq=False)  # identity semantics: _active_budgets.remove must never
+class CompileBudget:   # match a different-but-equal nested budget
+    """Live view handed out by :func:`compile_budget`; ``count`` updates as
+    compiles land inside the region."""
+
+    label: str
+    max_compiles: Optional[int]
+    count: int = 0
+
+
+@contextmanager
+def compile_budget(
+    max_compiles: Optional[int] = None,
+    label: str = "compile_budget",
+    on_excess: str = "raise",
+    logger=None,
+) -> Iterator[CompileBudget]:
+    """Count XLA backend compiles over a region; enforce a bound on exit.
+
+    ``max_compiles=None`` counts without enforcing (measurement mode).
+    ``on_excess``: ``"raise"`` (default) raises :class:`CompileBudgetExceeded`;
+    ``"warn"`` logs a warning on ``logger`` (or stderr) and continues.
+    Regions may nest; each counts independently. The count includes EVERY
+    backend compile in the region — internal helper ops (jnp constant
+    uploads etc.) too — so budgets should carry a few entries of slack
+    rather than an exact executable count.
+    """
+    if on_excess not in ("raise", "warn"):
+        raise ValueError(f"on_excess must be 'raise' or 'warn', got {on_excess!r}")
+    _ensure_listener()
+    budget = CompileBudget(label=label, max_compiles=max_compiles)
+    with _lock:
+        _active_budgets.append(budget)
+    clean_exit = False
+    try:
+        yield budget
+        clean_exit = True
+    finally:
+        with _lock:
+            _active_budgets.remove(budget)
+        # enforce ONLY on clean exit: an exception from the region must
+        # propagate as itself, not be replaced by a budget violation its
+        # aborted run may well have caused
+        if (
+            clean_exit
+            and budget.max_compiles is not None
+            and budget.count > budget.max_compiles
+        ):
+            exc = CompileBudgetExceeded(label, budget.count, budget.max_compiles)
+            if on_excess == "raise":
+                raise exc
+            if logger is not None:
+                logger.warning(str(exc))
+            else:  # pragma: no cover - fallback path
+                import sys
+
+                print(f"WARNING: {exc}", file=sys.stderr)
+
+
+@dataclass(eq=False)  # identity semantics: hashable for the weak registry
+class CompileTracker:
+    """Drainable compile counter for long-lived consumers (one per engine).
+
+    ``take()`` returns the number of backend compiles since the previous
+    ``take()`` and resets the pending count — the engine calls it at each
+    epoch boundary and logs a warning when steady-state epochs (probes
+    anchored, ladder warm) still compile."""
+
+    _pending: int = field(default=0, repr=False)
+
+    def __post_init__(self) -> None:
+        _ensure_listener()
+        with _lock:
+            _trackers.add(self)
+
+    def take(self) -> int:
+        with _lock:
+            n = self._pending
+            self._pending = 0
+        return n
+
+    def close(self) -> None:
+        """Optional eager deregistration; the weak registry also drops the
+        tracker automatically when its owner is collected."""
+        with _lock:
+            _trackers.discard(self)

@@ -1,0 +1,125 @@
+"""graftlint driver: parse each file once, hand the module to every rule,
+collect findings.
+
+The linter is repo-specific by design (ISSUE: the bug classes it encodes are
+the ones this repo shipped and fixed — see README "Static analysis"), so the
+rules are allowed to know idioms like ``self.steps.worker_step_first`` and
+``snap_to_bucket``. No import resolution, no type inference: a rule either
+matches a structural pattern in one module or stays quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+from dynamic_load_balance_distributeddnn_tpu.analysis import rules as _rules
+from dynamic_load_balance_distributeddnn_tpu.analysis.astutil import (
+    parent_map,
+    suppressed_rules,
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``fix_hint`` is the rule's canned autofix advice —
+    graftlint never rewrites code, it tells you the one-line remedy."""
+
+    code: str
+    path: str
+    line: int
+    col: int
+    message: str
+    fix_hint: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+            f"\n    fix: {self.fix_hint}"
+        )
+
+
+@dataclass
+class ModuleContext:
+    """Everything a rule needs about one parsed file."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    @classmethod
+    def from_source(cls, source: str, path: str = "<string>") -> "ModuleContext":
+        tree = ast.parse(source, filename=path)
+        return cls(
+            path=path,
+            source=source,
+            tree=tree,
+            lines=source.splitlines(),
+            parents=parent_map(tree),
+        )
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def is_suppressed(self, code: str, lineno: int) -> bool:
+        return code in suppressed_rules(self.line_text(lineno))
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run every (or the selected) rule over one source string."""
+    ctx = ModuleContext.from_source(source, path=path)
+    wanted = set(select) if select is not None else None
+    findings: List[Finding] = []
+    for code, rule in _rules.RULES.items():
+        if wanted is not None and code not in wanted:
+            continue
+        for f in rule.check(ctx):
+            if not ctx.is_suppressed(f.code, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def lint_file(path: str, select: Optional[Iterable[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    return lint_source(source, path=path, select=select)
+
+
+def _iter_py_files(path: str) -> Iterable[str]:
+    if os.path.isfile(path):
+        # explicit file arguments are linted regardless of extension
+        yield path
+        return
+    if not os.path.isdir(path):
+        # a typo'd path silently yielding nothing would turn a lint gate
+        # permanently green; fail loudly instead (CLI maps this to exit 2)
+        raise FileNotFoundError(f"no such file or directory: {path}")
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(
+            d for d in dirs if d not in ("__pycache__", ".git", ".pytest_cache")
+        )
+        for name in sorted(files):
+            if name.endswith(".py"):
+                yield os.path.join(root, name)
+
+
+def lint_paths(
+    paths: Iterable[str], select: Optional[Iterable[str]] = None
+) -> List[Finding]:
+    """Lint files and/or package directories (recursive)."""
+    findings: List[Finding] = []
+    for path in paths:
+        for file_path in _iter_py_files(path):
+            findings.extend(lint_file(file_path, select=select))
+    return findings

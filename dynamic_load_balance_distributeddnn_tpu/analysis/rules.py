@@ -1,0 +1,893 @@
+"""graftlint rules G001-G005.
+
+Each rule encodes one structural TPU/JAX perf-bug class this repo has
+actually shipped (the motivating incident is listed in README "Static
+analysis"). Rules are syntactic and single-file: they know the repo's idioms
+(``self.steps.worker_step_first``, ``snap_to_bucket``, the bucket ladder) and
+trade exhaustive soundness for zero-noise precision — a finding should always
+be worth reading.
+
+Suppress a deliberate violation inline with ``# graftlint: disable=G001``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.astutil import (
+    assign_targets,
+    call_name,
+    decorator_names,
+    dotted_name,
+    enclosing_functions,
+    enclosing_loop,
+    identifiers_in,
+    is_jit_construction,
+    jit_kwarg,
+    literal_int_tuple,
+)
+
+def _finding(code, ctx, node, message, fix_hint):
+    # local import: linter.py imports this module at its own import time
+    from dynamic_load_balance_distributeddnn_tpu.analysis.linter import Finding
+
+    return Finding(
+        code=code,
+        path=ctx.path,
+        line=getattr(node, "lineno", 0),
+        col=getattr(node, "col_offset", 0),
+        message=message,
+        fix_hint=fix_hint,
+    )
+
+
+# --------------------------------------------------------------------------
+# Shared repo knowledge
+
+# StepLibrary executables: calling one of these attributes dispatches a
+# compiled XLA program (engine/bench call them via ``self.steps.<name>``).
+KNOWN_STEP_ATTRS = {
+    "worker_step_first",
+    "worker_step_acc",
+    "worker_step_first_idx",
+    "worker_step_acc_idx",
+    "combine_update",
+    "combine_probe",
+    "fused_step",
+    "fused_epoch",
+    "fused_epoch_idx",
+    "fused_step_probe",
+    "fused_step_nocomm",
+    "comm_probe",
+    "fused_eval_step",
+}
+
+# StepLibrary executables that donate input buffers (steps.py donate_argnums),
+# keyed by attribute name -> donated positional indices.
+KNOWN_DONOR_ATTRS: Dict[str, Tuple[int, ...]] = {
+    "combine_update": (0, 1),
+    "fused_step": (0,),
+    "fused_epoch": (0,),
+    "fused_epoch_idx": (0,),
+    "worker_step_acc": (1,),
+    "worker_step_acc_idx": (1,),
+}
+
+_CLOCK_CALLS = {
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "perf_counter",
+    "monotonic",
+}
+
+_SYNC_TAILS = ("block_until_ready", "device_get", "item", "effects_barrier")
+_SYNC_NAMES = {"float", "np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+
+_TRACE_ENTRY_TAILS = (
+    "jax.jit",
+    "jit",
+    "pjit",
+    "jax.pjit",
+    "shard_map",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.vmap",
+    "vmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.lax.scan",
+    "lax.scan",
+    "jax.lax.cond",
+    "lax.cond",
+    "jax.lax.while_loop",
+    "lax.while_loop",
+    "jax.lax.fori_loop",
+    "lax.fori_loop",
+    "jax.lax.switch",
+    "lax.switch",
+)
+
+# Names whose presence in an expression marks its value as living on the
+# bucketed shape ladder (G003): the planner/quantizer surface plus the
+# engine's capacity-width properties.
+_BUCKET_MARKERS = {
+    "bucket",
+    "snap_to_bucket",
+    "quantize_batches",
+    "ladder",
+    "_cap_b",
+    "cap_b",
+    "_cap_packed",
+    "cap_packed",
+    "padded_batch",
+    "pad_to",
+}
+_BATCH_SOURCES = {"batch_size"}
+
+_SHAPE_BUILDERS = {
+    "np.zeros",
+    "numpy.zeros",
+    "jnp.zeros",
+    "np.ones",
+    "numpy.ones",
+    "jnp.ones",
+    "np.full",
+    "numpy.full",
+    "jnp.full",
+    "np.empty",
+    "numpy.empty",
+    "np.pad",
+    "numpy.pad",
+    "jnp.pad",
+    "_dummy_batch",
+}
+
+
+def _attr_tail(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _is_steps_attr(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    return ".steps." in name or _attr_tail(name) in KNOWN_STEP_ATTRS
+
+
+def _rhs_binds_jitted(value: ast.expr) -> bool:
+    """Does this assignment RHS produce a jitted/compiled callable?
+
+    jax.jit(...) itself, a StepLibrary executable attribute, a builder-idiom
+    call (``make_*``/``build_*`` returning a jitted callable), or a
+    conditional expression choosing between such values."""
+    if isinstance(value, ast.Call):
+        if is_jit_construction(value):
+            return True
+        name = call_name(value)
+        tail = _attr_tail(name)
+        if tail.startswith(("make_", "build_")):
+            return True
+        return False
+    if isinstance(value, ast.Attribute):
+        return _is_steps_attr(dotted_name(value))
+    if isinstance(value, ast.IfExp):
+        return _rhs_binds_jitted(value.body) or _rhs_binds_jitted(value.orelse)
+    return False
+
+
+def _jit_bound_names(tree: ast.Module) -> Set[str]:
+    """Every (possibly dotted) name the module ever binds to a jitted
+    callable. Module-wide and flow-insensitive — good enough for a linter."""
+    bound: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _rhs_binds_jitted(node.value):
+            for target in node.targets:
+                name = dotted_name(target)
+                if name:
+                    bound.add(name)
+    return bound
+
+
+def _is_dispatch_call(node: ast.Call, jit_bound: Set[str]) -> bool:
+    name = call_name(node)
+    if name is None:
+        # jax.jit(f)(x): the callee is itself a jit construction
+        return isinstance(node.func, ast.Call) and is_jit_construction(node.func)
+    if name in jit_bound:
+        return True
+    return _is_steps_attr(name)
+
+
+def _is_sync_call(node: ast.Call) -> bool:
+    # method spelling works on any receiver, resolvable or not:
+    # fn(args).block_until_ready(), arr.item(), ...
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_TAILS:
+        return True
+    name = call_name(node)
+    if name is None:
+        return False
+    return name in _SYNC_NAMES or _attr_tail(name) in _SYNC_TAILS
+
+
+def _innermost_function(node: ast.AST, parents) -> Optional[ast.AST]:
+    chain = enclosing_functions(node, parents)
+    return chain[0] if chain else None
+
+
+def _function_calls(fn: ast.AST, parents) -> List[ast.Call]:
+    """Call nodes whose innermost enclosing function is ``fn`` itself (nested
+    defs and lambdas are their own scopes and analyzed separately)."""
+    return [
+        n
+        for n in ast.walk(fn)
+        if isinstance(n, ast.Call) and _innermost_function(n, parents) is fn
+    ]
+
+
+# --------------------------------------------------------------------------
+# G001 — jit construction in a hot scope
+
+
+class RuleG001:
+    code = "G001"
+    summary = "jax.jit/pjit constructed inside a per-call function or loop body"
+    fix_hint = (
+        "hoist the jit construction to module scope, __init__, or a cached "
+        "builder (functools.cached_property/lru_cache) so the executable "
+        "compiles once instead of per call"
+    )
+
+    _ALLOWED_NAMES = {"__init__", "__post_init__", "setup", "__init_subclass__"}
+    _ALLOWED_PREFIXES = ("build", "_build", "make_", "_make", "create_", "_create")
+    _ALLOWED_DECORATORS = {
+        "cached_property",
+        "functools.cached_property",
+        "lru_cache",
+        "functools.lru_cache",
+        "cache",
+        "functools.cache",
+    }
+
+    def _scope_allowed_shallow(self, fn: ast.AST) -> bool:
+        if isinstance(fn, ast.Lambda):
+            return False
+        name = fn.name
+        if name in self._ALLOWED_NAMES or name.startswith(self._ALLOWED_PREFIXES):
+            return True
+        return bool(set(decorator_names(fn)) & self._ALLOWED_DECORATORS)
+
+    def _scope_allowed(
+        self,
+        fn: ast.AST,
+        ctx,
+        memo: Dict[ast.AST, bool],
+        stack: Set[ast.AST],
+    ) -> bool:
+        """A scope is setup-safe if it IS a setup scope, or every call site of
+        it in this module sits inside a setup-safe scope (transitively) — the
+        ``_fused_probe``-called-from-cached_property pattern."""
+        if fn in memo:
+            return memo[fn]
+        if fn in stack:  # recursion: cannot prove, disallow
+            return False
+        if self._scope_allowed_shallow(fn):
+            memo[fn] = True
+            return True
+        if isinstance(fn, ast.Lambda):
+            memo[fn] = False
+            return False
+        stack.add(fn)
+        try:
+            sites = [
+                c
+                for c in ast.walk(ctx.tree)
+                if isinstance(c, ast.Call) and _attr_tail(call_name(c)) == fn.name
+            ]
+            if not sites:
+                memo[fn] = False
+                return False
+            for site in sites:
+                enclosing = _innermost_function(site, ctx.parents)
+                if enclosing is None:
+                    continue  # module-scope call site: setup by definition
+                if not self._scope_allowed(enclosing, ctx, memo, stack):
+                    memo[fn] = False
+                    return False
+            memo[fn] = True
+            return True
+        finally:
+            stack.discard(fn)
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        memo: Dict[ast.AST, bool] = {}
+        sites: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and is_jit_construction(node):
+                # skip bare functools.partial(jax.jit, ...) used as a
+                # decorator — the decorated def is handled below
+                parent = ctx.parents.get(node)
+                if (
+                    isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node in parent.decorator_list
+                ):
+                    continue
+                sites.append((node, "jit construction"))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                jit_tails = {"jax.jit", "jit", "pjit", "jax.pjit"}
+                if set(decorator_names(node)) & jit_tails:
+                    sites.append((node, f"@jit-decorated def {node.name}"))
+
+        for node, what in sites:
+            fn = _innermost_function(node, ctx.parents)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and fn is node:
+                fn = _innermost_function(ctx.parents.get(node), ctx.parents)
+            loop = enclosing_loop(node, ctx.parents, stop_at=fn)
+            if loop is not None:
+                yield _finding(
+                    self.code,
+                    ctx,
+                    node,
+                    f"{what} inside a loop body recompiles every iteration",
+                    self.fix_hint,
+                )
+                continue
+            if fn is None:
+                continue  # module/class scope: compiled once per import
+            if not self._scope_allowed(fn, ctx, memo, set()):
+                yield _finding(
+                    self.code,
+                    ctx,
+                    node,
+                    f"{what} inside `{getattr(fn, 'name', '<lambda>')}` "
+                    "(a per-call scope): each call builds a fresh wrapper and "
+                    "recompiles — the engine.py _probe_workers `tiny` bug class",
+                    self.fix_hint,
+                )
+
+
+# --------------------------------------------------------------------------
+# G002 — wall-clock window spans a dispatch with no sync on the timed path
+
+
+class RuleG002:
+    code = "G002"
+    summary = "wall-clock timing spans a dispatched JAX call with no sync"
+    fix_hint = (
+        "call jax.block_until_ready(...) (or read the value back with "
+        "float()/device_get) on the dispatched result before taking the "
+        "closing timestamp — async dispatch returns immediately and the "
+        "wall measures nothing"
+    )
+
+    @staticmethod
+    def _is_clock_call(node: ast.expr) -> bool:
+        return isinstance(node, ast.Call) and call_name(node) in _CLOCK_CALLS
+
+    def _windows(self, fn: ast.AST, ctx) -> List[Tuple[str, int, int]]:
+        """(varname, start_line, end_line) spans: ``t0 = clock()`` up to the
+        nearest later use of ``clock() - t0``."""
+        starts: List[Tuple[str, int]] = []
+        deltas: List[Tuple[str, int]] = []
+        for node in ast.walk(fn):
+            if _innermost_function(node, ctx.parents) is not fn:
+                continue
+            if isinstance(node, ast.Assign) and self._is_clock_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        starts.append((t.id, node.lineno))
+            elif (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and self._is_clock_call(node.left)
+                and isinstance(node.right, ast.Name)
+            ):
+                deltas.append((node.right.id, node.lineno))
+        windows = []
+        for var, s_line in starts:
+            ends = sorted(line for v, line in deltas if v == var and line > s_line)
+            if ends:
+                windows.append((var, s_line, ends[0]))
+        return windows
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        jit_bound = _jit_bound_names(ctx.tree)
+        fns = [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in fns:
+            windows = self._windows(fn, ctx)
+            if not windows:
+                continue
+            calls = _function_calls(fn, ctx.parents)
+            for var, s_line, e_line in windows:
+                in_window = [
+                    c for c in calls if s_line < c.lineno <= e_line
+                ]
+                dispatches = [
+                    c for c in in_window if _is_dispatch_call(c, jit_bound)
+                ]
+                if not dispatches:
+                    continue
+                # the sync must cover the LAST dispatch: a block_until_ready
+                # that merely drains earlier work (the warm-then-time mistake)
+                # leaves the timed dispatch itself unsynced
+                last_dispatch_line = max(c.lineno for c in dispatches)
+                if any(
+                    _is_sync_call(c) and c.lineno >= last_dispatch_line
+                    for c in in_window
+                ):
+                    continue
+                c0 = dispatches[0]
+                yield _finding(
+                    self.code,
+                    ctx,
+                    c0,
+                    f"timed window `{var}` (lines {s_line}-{e_line}) spans the "
+                    f"dispatched call `{call_name(c0) or '<jit>'}` with no "
+                    "block_until_ready/device_get/readback on the timed path",
+                    self.fix_hint,
+                )
+
+
+# --------------------------------------------------------------------------
+# G003 — batch shapes at jit call sites off the bucket ladder
+
+
+class RuleG003:
+    code = "G003"
+    summary = "batch-size value reaches a jitted call site without bucket snapping"
+    fix_hint = (
+        "route the batch size through quantize_batches/snap_to_bucket (or a "
+        "capacity width like _cap_b) before it determines a compiled shape — "
+        "every off-ladder shape is a fresh XLA compile inside a timed epoch"
+    )
+
+    @staticmethod
+    def _mentions(node: ast.AST, idents: Set[str]) -> bool:
+        return bool(identifiers_in(node) & idents)
+
+    def _tainted_names(self, fn: ast.AST, ctx) -> Set[str]:
+        """Names assigned from raw-batch-size expressions that never pass a
+        bucketing marker. One forward pass + fixpoint over local assigns."""
+        assigns: List[Tuple[Set[str], ast.expr]] = []
+        for node in ast.walk(fn):
+            if _innermost_function(node, ctx.parents) is not fn:
+                continue
+            if isinstance(node, ast.Assign):
+                targets = assign_targets(node)
+                if targets:
+                    assigns.append((targets, node.value))
+        tainted: Set[str] = set()
+        for _ in range(4):  # tiny fixpoint; local chains are short
+            changed = False
+            for targets, value in assigns:
+                if self._mentions(value, _BUCKET_MARKERS):
+                    continue
+                if self._mentions(value, _BATCH_SOURCES | tainted):
+                    new = targets - tainted
+                    if new:
+                        tainted |= new
+                        changed = True
+            if not changed:
+                break
+        return tainted
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        jit_bound = _jit_bound_names(ctx.tree)
+        fns = [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in fns:
+            calls = _function_calls(fn, ctx.parents)
+            dispatches = [c for c in calls if _is_dispatch_call(c, jit_bound)]
+            if not dispatches:
+                continue
+            tainted = self._tainted_names(fn, ctx)
+            hot = _BATCH_SOURCES | tainted
+            for c in calls:
+                name = call_name(c)
+                is_shape_builder = (
+                    name in _SHAPE_BUILDERS or _attr_tail(name) in _SHAPE_BUILDERS
+                )
+                is_dispatch = c in dispatches
+                if not (is_shape_builder or is_dispatch):
+                    continue
+                for arg in list(c.args) + [kw.value for kw in c.keywords]:
+                    if self._mentions(arg, _BUCKET_MARKERS):
+                        continue
+                    if self._mentions(arg, hot):
+                        kind = "shape builder" if is_shape_builder else "jitted call"
+                        yield _finding(
+                            self.code,
+                            ctx,
+                            c,
+                            f"{kind} `{name}` in `{fn.name}` consumes a raw "
+                            "batch-size value that never passed "
+                            "snap_to_bucket/quantize_batches — off-ladder "
+                            "shapes recompile every rebalance",
+                            self.fix_hint,
+                        )
+                        break
+
+
+# --------------------------------------------------------------------------
+# G004 — host coercion / Python control flow on traced values
+
+
+class RuleG004:
+    code = "G004"
+    summary = "host coercion or Python control flow on a traced value in a jitted scope"
+    fix_hint = (
+        "inside jit, branch with jax.lax.cond/select and keep values as jnp "
+        "arrays; float()/int()/bool()/np.asarray() on a tracer either raises "
+        "ConcretizationTypeError or silently constant-folds at trace time"
+    )
+
+    _COERCIONS = {
+        "float",
+        "int",
+        "bool",
+        "complex",
+        "np.asarray",
+        "numpy.asarray",
+        "np.array",
+        "numpy.array",
+        "np.float32",
+        "np.float64",
+        "np.int32",
+        "np.int64",
+        "np.bool_",
+    }
+    _COERCION_TAILS = ("item", "tolist")
+    _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+    def _traced_scopes(self, ctx) -> List[Tuple[ast.AST, Set[str]]]:
+        """(function node, traced parameter names). Scopes: defs decorated
+        with jit, defs/lambdas passed by name into a jax trace entry point
+        (jit, shard_map, grad, scan, ...)."""
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        scopes: Dict[ast.AST, Tuple[Optional[Tuple[int, ...]], object]] = {}
+
+        def add(fn: ast.AST, static_argnums=None, static_argnames=None):
+            # merge: a def can be marked traced from several sites (decorator
+            # plus a by-name lax.scan reference); statics learned at any one
+            # of them must not be clobbered by a later site's None
+            prev_nums, prev_names = scopes.get(fn, (None, None))
+            scopes[fn] = (
+                static_argnums if static_argnums is not None else prev_nums,
+                static_argnames if static_argnames is not None else prev_names,
+            )
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                decs = set(decorator_names(node))
+                if decs & {"jax.jit", "jit", "pjit", "jax.pjit"}:
+                    nums = names = None
+                    for dec in node.decorator_list:
+                        # read statics only off the jit decorator itself, not
+                        # any other Call decorator stacked on the same def
+                        if not (isinstance(dec, ast.Call) and is_jit_construction(dec)):
+                            continue
+                        nums = literal_int_tuple(jit_kwarg(dec, "static_argnums"))
+                        names_node = jit_kwarg(dec, "static_argnames")
+                        try:
+                            names = ast.literal_eval(names_node) if names_node else None
+                        except (ValueError, SyntaxError):
+                            names = None
+                    add(node, nums, names)
+            elif isinstance(node, ast.Call) and call_name(node) in _TRACE_ENTRY_TAILS:
+                nums = literal_int_tuple(jit_kwarg(node, "static_argnums"))
+                names_node = jit_kwarg(node, "static_argnames")
+                try:
+                    names = ast.literal_eval(names_node) if names_node else None
+                except (ValueError, SyntaxError):
+                    names = None
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        for d in defs.get(arg.id, []):
+                            add(d, nums, names)
+                    elif isinstance(arg, ast.Lambda):
+                        add(arg, nums, names)
+
+        out: List[Tuple[ast.AST, Set[str]]] = []
+        for fn, statics in scopes.items():
+            nums, names = statics if statics else (None, None)
+            args = fn.args
+            params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+            traced = set(params) - {"self", "cls"}
+            if nums:
+                all_pos = [a.arg for a in args.posonlyargs + args.args]
+                for i in nums:
+                    if 0 <= i < len(all_pos):
+                        traced.discard(all_pos[i])
+            if names:
+                if isinstance(names, str):
+                    names = (names,)
+                traced -= set(names)
+            out.append((fn, traced))
+        return out
+
+    def _live_traced(self, expr: ast.AST, traced: Set[str]) -> bool:
+        """Does ``expr`` mention a traced name outside static accessors
+        (``x.shape``/``x.ndim``/``x.dtype``/``len(x)``)?"""
+
+        def walk(node: ast.AST) -> bool:
+            if isinstance(node, ast.Attribute) and node.attr in self._STATIC_ATTRS:
+                return False
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "len"
+            ):
+                return False
+            if isinstance(node, ast.Name) and node.id in traced:
+                return True
+            return any(walk(c) for c in ast.iter_child_nodes(node))
+
+        return walk(expr)
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        for fn, params in self._traced_scopes(ctx):
+            traced = set(params)
+            # forward propagation through local assignments
+            for node in ast.walk(fn):
+                if _innermost_function(node, ctx.parents) is not fn:
+                    continue
+                if isinstance(node, ast.Assign) and self._live_traced(
+                    node.value, traced
+                ):
+                    traced |= assign_targets(node)
+            for node in ast.walk(fn):
+                if _innermost_function(node, ctx.parents) is not fn:
+                    continue
+                if isinstance(node, ast.Call):
+                    name = call_name(node)
+                    coercing = name in self._COERCIONS or (
+                        _attr_tail(name) in self._COERCION_TAILS and not node.args
+                    )
+                    if coercing and any(
+                        self._live_traced(a, traced) for a in node.args
+                    ):
+                        yield _finding(
+                            self.code,
+                            ctx,
+                            node,
+                            f"`{name}` coerces a traced value to host inside "
+                            f"jitted scope `{getattr(fn, 'name', '<lambda>')}`",
+                            self.fix_hint,
+                        )
+                    elif coercing and _attr_tail(name) in self._COERCION_TAILS:
+                        recv = node.func.value if isinstance(node.func, ast.Attribute) else None
+                        if recv is not None and self._live_traced(recv, traced):
+                            yield _finding(
+                                self.code,
+                                ctx,
+                                node,
+                                f"`.{_attr_tail(name)}()` reads a traced value "
+                                f"back to host inside jitted scope "
+                                f"`{getattr(fn, 'name', '<lambda>')}`",
+                                self.fix_hint,
+                            )
+                elif isinstance(node, (ast.If, ast.While)):
+                    if self._live_traced(node.test, traced):
+                        yield _finding(
+                            self.code,
+                            ctx,
+                            node,
+                            "Python control flow on a traced value inside "
+                            f"jitted scope `{getattr(fn, 'name', '<lambda>')}` "
+                            "— the branch is resolved once at trace time",
+                            self.fix_hint,
+                        )
+                elif isinstance(node, ast.Assert):
+                    if self._live_traced(node.test, traced):
+                        yield _finding(
+                            self.code,
+                            ctx,
+                            node,
+                            "assert on a traced value inside jitted scope "
+                            f"`{getattr(fn, 'name', '<lambda>')}`",
+                            self.fix_hint,
+                        )
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    if self._live_traced(node.iter, traced):
+                        yield _finding(
+                            self.code,
+                            ctx,
+                            node,
+                            "Python loop over a traced value inside jitted "
+                            f"scope `{getattr(fn, 'name', '<lambda>')}` — use "
+                            "lax.fori_loop/scan",
+                            self.fix_hint,
+                        )
+
+
+# --------------------------------------------------------------------------
+# G005 — donated buffer referenced after the donating call
+
+
+class RuleG005:
+    code = "G005"
+    summary = "donated buffer read after a donate_argnums call"
+    fix_hint = (
+        "rebind the variable from the call's result (x = f(x, ...)) or use "
+        "the non-donating probe twin; a donated buffer's storage is reused "
+        "by XLA and reading it is undefined (DeletedBuffer on TPU)"
+    )
+
+    def _donors(self, ctx) -> Dict[str, Tuple[int, ...]]:
+        """name/attr-tail -> donated argnums, from same-file jit(...,
+        donate_argnums=...) bindings and the StepLibrary knowledge table."""
+        donors = dict(KNOWN_DONOR_ATTRS)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if is_jit_construction(node.value):
+                    nums = literal_int_tuple(jit_kwarg(node.value, "donate_argnums"))
+                    if nums:
+                        for t in node.targets:
+                            name = dotted_name(t)
+                            if name:
+                                donors[_attr_tail(name)] = nums
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and is_jit_construction(dec):
+                        nums = literal_int_tuple(jit_kwarg(dec, "donate_argnums"))
+                        if nums:
+                            donors[node.name] = nums
+        return donors
+
+    @staticmethod
+    def _stmt_list(fn: ast.AST, ctx) -> List[ast.stmt]:
+        """All statements whose innermost function is ``fn``, source order."""
+        stmts = [
+            n
+            for n in ast.walk(fn)
+            if isinstance(n, ast.stmt)
+            and n is not fn
+            and _innermost_function(n, ctx.parents) is fn
+        ]
+        return sorted(stmts, key=lambda s: (s.lineno, s.col_offset))
+
+    @staticmethod
+    def _shallow_walk(stmt: ast.stmt):
+        """``stmt`` and its non-statement descendants. Nested statements are
+        NOT entered: each appears in the flattened statement list on its own
+        turn, so scanning them here would read a compound statement's body
+        before its own inner rebinds are considered."""
+        stack: List[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, ast.stmt):
+                    stack.append(child)
+
+    @classmethod
+    def _reads_token(cls, stmt: ast.stmt, token: str) -> Optional[ast.AST]:
+        for n in cls._shallow_walk(stmt):
+            if dotted_name(n) == token and isinstance(
+                getattr(n, "ctx", None), ast.Load
+            ):
+                return n
+        return None
+
+    @staticmethod
+    def _binds_token(stmt: ast.stmt, token: str) -> bool:
+        if token in assign_targets(stmt):
+            return True
+
+        def flat(t: ast.expr):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    yield from flat(e)
+            elif isinstance(t, ast.Starred):
+                yield from flat(t.value)
+            else:
+                yield t
+
+        if isinstance(stmt, ast.Assign):
+            return any(
+                dotted_name(e) == token for t in stmt.targets for e in flat(t)
+            )
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            return dotted_name(stmt.target) == token
+        return False
+
+    @staticmethod
+    def _nearest_stmt(node: ast.AST, parents) -> Optional[ast.stmt]:
+        cur = parents.get(node)
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = parents.get(cur)
+        return cur if isinstance(cur, ast.stmt) else None
+
+    @staticmethod
+    def _mutually_exclusive(a: ast.AST, b: ast.AST, parents) -> bool:
+        """True when ``a`` and ``b`` sit in different arms of the same If —
+        they can never both execute, so a donate in one arm does not poison
+        a read in the other (keeps the zero-noise contract on the common
+        donate-in-early-return-branch pattern)."""
+        chain_a: List[ast.AST] = []
+        n: Optional[ast.AST] = a
+        while n is not None:
+            chain_a.append(n)
+            n = parents.get(n)
+        index_a = {id(x): i for i, x in enumerate(chain_a)}
+        n, prev_b = b, b
+        while n is not None and id(n) not in index_a:
+            prev_b = n
+            n = parents.get(n)
+        if n is None or not isinstance(n, ast.If):
+            return False
+        i = index_a[id(n)]
+        prev_a = chain_a[i - 1] if i > 0 else a
+
+        def arm(child: ast.AST) -> Optional[str]:
+            if any(child is s for s in n.body):
+                return "body"
+            if any(child is s for s in n.orelse):
+                return "orelse"
+            return None
+
+        arm_a, arm_b = arm(prev_a), arm(prev_b)
+        return arm_a is not None and arm_b is not None and arm_a != arm_b
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        donors = self._donors(ctx)
+        fns = [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in fns:
+            stmts = self._stmt_list(fn, ctx)
+            index_of = {id(s): i for i, s in enumerate(stmts)}
+            for node in _function_calls(fn, ctx.parents):
+                stmt = self._nearest_stmt(node, ctx.parents)
+                i = index_of.get(id(stmt))
+                if i is None:
+                    continue
+                nums = donors.get(_attr_tail(call_name(node)))
+                if not nums:
+                    continue
+                for argnum in nums:
+                    if argnum >= len(node.args):
+                        continue
+                    token = dotted_name(node.args[argnum])
+                    if token is None:
+                        continue
+                    # donated-and-rebound in the same statement is the
+                    # safe idiom: state = f(state, ...)
+                    if self._binds_token(stmt, token):
+                        continue
+                    for later in stmts[i + 1:]:
+                        if self._mutually_exclusive(stmt, later, ctx.parents):
+                            continue
+                        read = self._reads_token(later, token)
+                        if read is not None:
+                            yield _finding(
+                                self.code,
+                                ctx,
+                                read,
+                                f"`{token}` was donated to "
+                                f"`{call_name(node)}` on line "
+                                f"{node.lineno} and read again here",
+                                self.fix_hint,
+                            )
+                            break
+                        if self._binds_token(later, token):
+                            break
+
+
+RULES: Dict[str, object] = {
+    r.code: r for r in (RuleG001(), RuleG002(), RuleG003(), RuleG004(), RuleG005())
+}

@@ -77,16 +77,34 @@ def ring_exchange_times(local_times: np.ndarray, mesh=None) -> np.ndarray:
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
 
-    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
-        DATA_AXIS,
-        data_mesh,
-    )
+    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import data_mesh
 
     mesh = mesh or data_mesh()
     n = len(mesh.devices.flat)
     times = jnp.asarray(local_times, dtype=jnp.float32)
+    return np.asarray(_build_ring_exchange(mesh, n)(times), dtype=np.float64)
+
+
+_RING_EXCHANGE_CACHE: dict = {}
+
+
+def _build_ring_exchange(mesh, n: int):
+    """Compile the ring all-gather ONCE per (mesh, n): the pre-fix form built
+    a fresh jit wrapper (a fresh closure identity, so a fresh XLA compile)
+    inside ring_exchange_times on every call — graftlint G001."""
+    cached = _RING_EXCHANGE_CACHE.get((mesh, n))
+    if cached is not None:
+        return cached
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+        DATA_AXIS,
+        shard_map,
+    )
 
     def ring(t_local):
         # t_local: [1] — this device's scalar. Accumulate into slot idx of a
@@ -109,7 +127,7 @@ def ring_exchange_times(local_times: np.ndarray, mesh=None) -> np.ndarray:
         return out
 
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             ring,
             mesh=mesh,
             in_specs=P(DATA_AXIS),
@@ -117,4 +135,5 @@ def ring_exchange_times(local_times: np.ndarray, mesh=None) -> np.ndarray:
             check_vma=False,
         )
     )
-    return np.asarray(sharded(times), dtype=np.float64)
+    _RING_EXCHANGE_CACHE[(mesh, n)] = sharded
+    return sharded

@@ -21,6 +21,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import axis_size
+
 
 def sinusoidal_positions(max_len: int, d_model: int) -> np.ndarray:
     pos = np.arange(max_len, dtype=np.float32)[:, None]
@@ -215,7 +217,7 @@ class TransformerLM(nn.Module):
         if self.seq_axis:
             # sequence-parallel: this shard holds global positions
             # [idx*t, (idx+1)*t) — offset the positional encoding accordingly
-            n_shards = jax.lax.axis_size(self.seq_axis)
+            n_shards = axis_size(self.seq_axis)
             pe = jnp.asarray(
                 sinusoidal_positions(min(self.max_len, n_shards * t), self.ninp)
             )

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import axis_size, shard_map
+
 SEQ_AXIS = "data"  # default: reuse the 1-D mesh; a 2-D mesh can name its own
 
 
@@ -60,7 +62,7 @@ def ring_self_attention(
     q, k, v: local blocks [B, H, T_local, D] (call from inside shard_map).
     Returns the local output block [B, H, T_local, D].
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     t_local = q.shape[2]
 
@@ -96,7 +98,7 @@ def ring_self_attention(
 def make_ring_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS, causal: bool = True):
     """jit-ready global-array wrapper: q,k,v [B, H, T_global, D] sharded on T."""
 
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ring_self_attention, axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(

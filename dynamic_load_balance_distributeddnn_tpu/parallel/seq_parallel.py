@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS, shard_map
 
 
 def make_seq_parallel_apply(
@@ -33,7 +33,7 @@ def make_seq_parallel_apply(
     def local_apply(params, tokens):
         return model.apply(params, tokens, train=False)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local_apply,
         mesh=mesh,
         in_specs=(P(), P(None, axis_name)),
@@ -76,7 +76,7 @@ def make_seq_parallel_value_and_grad(
     # replicated params (sum of per-shard cotangents inserted exactly once)
     # and for the ring's ppermute flows. Differentiating inside the shard
     # program instead double-counts whatever traveled through collectives.
-    sharded_loss = jax.shard_map(
+    sharded_loss = shard_map(
         local_loss,
         mesh=mesh,
         in_specs=(P(), P(None, axis_name), P(None, axis_name), P()),

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import axis_size, shard_map
+
 SEQ_AXIS = "data"
 
 
@@ -38,7 +40,7 @@ def ulysses_self_attention(
     Returns the local output block [B, H, T_local, D]. H must divide by the
     axis size.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     h = q.shape[1]
     assert h % n == 0, f"num_heads {h} must divide by axis size {n}"
 
@@ -72,7 +74,7 @@ def make_ulysses_attention_fn(
 ):
     """jit-ready global-array wrapper: q,k,v [B, H, T_global, D] sharded on T."""
 
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             ulysses_self_attention,
             axis_name=axis_name,

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dynamic_load_balance_distributeddnn_tpu.analysis.guards import CompileTracker
 from dynamic_load_balance_distributeddnn_tpu.balance import (
     TimeKeeper,
     exchange_times,
@@ -68,6 +69,12 @@ from dynamic_load_balance_distributeddnn_tpu.train.steps import (
     shard_views,
     stack_partials,
 )
+
+# Dispatch-overhead probe op, constructed ONCE per process: building it inside
+# _probe_workers (the pre-fix form, kept as the canonical G001 fixture in
+# tests/fixtures/graftlint/g001_violation.py) made every probe epoch pay a
+# fresh wrapper + XLA compile for a no-op.
+_tiny_sync_probe = jax.jit(lambda a: a + 1.0)
 
 
 class Trainer:
@@ -171,6 +178,15 @@ class Trainer:
         self._needs_iter_cost = cfg.fault_mode == "compute" and not isinstance(
             self.injector, NullInjector
         )
+
+        # XLA-recompile sentinel (analysis/guards.py): drained every epoch.
+        # A compile on a plan layout seen before means a shape fell off the
+        # bucket ladder or a jit wrapper was rebuilt inside a timed epoch —
+        # invisible in the wall on a fast chip, poison for the DBS signal.
+        # (First-visit compiles of a fresh layout are expected lazy work when
+        # warm_start is off.)
+        self._compile_tracker = CompileTracker()
+        self._seen_plan_layouts: set = set()
 
         self.recorder = MetricsRecorder()
         self.recorder.stamp_data_source(
@@ -662,6 +678,29 @@ class Trainer:
                 u = mfu(self._epoch_flops / epoch_wall, self.n_dev)
                 if u is not None:
                     extras["mfu_bf16_peak"] = u
+
+        # Recompile sentinel: a plan layout the run has already executed must
+        # never compile again — if it does, a shape fell off the bucket
+        # ladder or a jit wrapper was rebuilt (graftlint G001/G003). A fresh
+        # layout compiling is ordinary lazy work (warm_start off). Recorded
+        # every epoch so the series stays aligned.
+        # the layout must capture every compiled-shape dimension a plan
+        # controls: padded widths AND the step counts (fused window shapes
+        # carry plan.num_steps / per-worker steps in their leading dims)
+        plan_layout = (int(plan.num_steps),) + tuple(
+            (int(w.padded_batch), int(w.steps)) for w in plan.workers
+        )
+        layout_seen = plan_layout in self._seen_plan_layouts
+        self._seen_plan_layouts.add(plan_layout)
+        epoch_compiles = self._compile_tracker.take()
+        extras["xla_compiles"] = float(epoch_compiles)
+        if epoch_compiles and layout_seen and epoch >= 1:
+            self.logger.warning(
+                f"Epoch {epoch}: {epoch_compiles} XLA backend compile(s) on "
+                f"an already-executed plan layout {list(plan_layout)} — a "
+                "shape fell off the bucket ladder or a jit wrapper was "
+                "rebuilt (graftlint G001/G003)"
+            )
 
         heartbeat()  # epoch complete — device answered end-to-end
         self.recorder.record_epoch(
@@ -1475,19 +1514,18 @@ class Trainer:
         # zero out a real measurement.
         ovh_by_dev: dict = {}
         if getattr(cfg, "probe_overhead_correction", True):
-            tiny = jax.jit(lambda a: a + 1.0)
             for d in topo.used_device_indices:
                 tx = jax.device_put(jnp.float32(0.0), topo.devices[d])
-                y = tiny(tx)
+                y = _tiny_sync_probe(tx)
                 jax.block_until_ready(y)
                 float(y)  # compile + warm both sync paths
                 e_block = e_read = float("inf")
                 for _ in range(3):
                     t0 = time.perf_counter()
-                    jax.block_until_ready(tiny(tx))
+                    jax.block_until_ready(_tiny_sync_probe(tx))
                     e_block = min(e_block, time.perf_counter() - t0)
                     t0 = time.perf_counter()
-                    float(tiny(tx))
+                    float(_tiny_sync_probe(tx))
                     e_read = min(e_read, time.perf_counter() - t0)
                 ovh_by_dev[d] = min(e_block, e_read)
             self._probe_overhead_s = max(ovh_by_dev.values())
@@ -1496,8 +1534,15 @@ class Trainer:
             )
 
         def timed(d: int, args2):
-            """(min-over-reps blocking wall minus the device's dispatch
-            overhead, last partial) of one probe step."""
+            """(corrected wall, raw wall, last partial) of one probe step:
+            min-over-reps blocking wall, minus the device's dispatch overhead
+            for the corrected value. PAIRED measurements (the closed-loop
+            iteration-cost tracking and _calibrate_iter_cost) must difference
+            the RAW walls: the correction's 0.2*dt floor binds only on the
+            small (clean) leg of a pair, so differencing corrected values
+            re-introduces exactly the overhead the pairing exists to cancel.
+            Standalone anchors (per-example cost, the solver's time vector)
+            keep the corrected value."""
             dt, acc = float("inf"), None
             for _ in range(reps):
                 t0 = time.perf_counter()
@@ -1505,7 +1550,7 @@ class Trainer:
                 jax.block_until_ready(aux)
                 dt = min(dt, time.perf_counter() - t0)
             heartbeat()
-            return max(dt - ovh_by_dev.get(d, 0.0), 0.2 * dt), acc
+            return max(dt - ovh_by_dev.get(d, 0.0), 0.2 * dt), dt, acc
 
         lo, hi = self.rank_lo, self.rank_lo + self.ws_local
         init_epoch = bool(np.isnan(self.per_example_cost[lo:hi]).any())
@@ -1517,7 +1562,7 @@ class Trainer:
                 gr = self.rank_lo + r
                 # probe with the non-donating first-step executable so reps
                 # are safe; each worker is measured standalone
-                dt, acc = timed(d, args)
+                dt, dt_raw, acc = timed(d, args)
                 w_plan = plan.workers[gr]
                 self.timekeeper.add_compute(gr, dt * w_plan.steps)
                 slow_n = float(faults.slow_iters_per_step[gr])
@@ -1547,8 +1592,11 @@ class Trainer:
                     #    counted epoch injects the same strength — the A/B
                     #    contract the bench asserts per arm.
                     zero = jax.device_put(jnp.int32(0), topo.devices[d])
-                    dt_clean, _ = timed(d, args[:-1] + (zero,))
-                    realized = (dt - dt_clean) / slow_n
+                    _, raw_clean, _ = timed(d, args[:-1] + (zero,))
+                    # raw-minus-raw: the per-probe dispatch overhead appears
+                    # in both walls and cancels; corrected values would pair
+                    # a floored clean leg against an unfloored injected leg
+                    realized = (dt_raw - raw_clean) / slow_n
                     if realized > 0 and np.isfinite(realized):
                         prev = self._iter_cost_s or realized
                         self._iter_cost_s = 0.5 * prev + 0.5 * realized
@@ -1584,7 +1632,7 @@ class Trainer:
                         # cold AND injected dt; re-anchor on a zero-slow probe
                         zero = jax.device_put(jnp.int32(0), topo.devices[d])
                         args = args[:-1] + (zero,)
-                    dt, _ = timed(d, args)
+                    dt, _, _ = timed(d, args)
                     self.per_example_cost[gr] = max(dt, 1e-9) / max(
                         plan.workers[gr].batch_size, 1
                     )
@@ -1635,7 +1683,10 @@ class Trainer:
 
         def timed_probe(slow_n: int) -> float:
             test_args = args[:-1] + (jax.device_put(jnp.int32(slow_n), dev),)
-            return timed(d, test_args)[0]
+            # RAW wall: both legs of the paired delta below carry the same
+            # dispatch overhead, so it cancels; the corrected value's 0.2*dt
+            # floor fires only on the short clean leg and would bias the pair
+            return timed(d, test_args)[1]
 
         for _ in range(4):
             slow_n = max(int(round(clean / max(guess, 1e-12))), 1)

@@ -41,7 +41,7 @@ from dynamic_load_balance_distributeddnn_tpu.ops.losses import (
     per_example_cross_entropy,
     per_example_nll,
 )
-from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS, shard_map
 from dynamic_load_balance_distributeddnn_tpu.train.state import TrainState
 
 
@@ -441,7 +441,7 @@ class StepLibrary:
         def per_shard(state, x, y, w, slow_iters, seed):
             return self._fused_shard_body(state, x, y, w, slow_iters[0], seed)
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             per_shard,
             mesh=self.mesh,
             in_specs=(self._state_spec(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
@@ -466,7 +466,7 @@ class StepLibrary:
             state, metrics = jax.lax.scan(body, state, (xs, ys, ws_))
             return state, jnp.sum(metrics, axis=0)
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             per_shard,
             mesh=self.mesh,
             in_specs=(
@@ -500,7 +500,7 @@ class StepLibrary:
             state, metrics = jax.lax.scan(body, state, (idxs, ws_))
             return state, jnp.sum(metrics, axis=0)
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             per_shard,
             mesh=self.mesh,
             in_specs=(
@@ -528,7 +528,7 @@ class StepLibrary:
                 state, x, y, w, slow_iters[0], seed, with_comm=with_comm
             )
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             per_shard,
             mesh=self.mesh,
             in_specs=(self._state_spec(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
@@ -555,7 +555,7 @@ class StepLibrary:
         def per_shard(tree):
             return jax.lax.psum(tree, DATA_AXIS)
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             per_shard,
             mesh=self.mesh,
             in_specs=(P(),),
@@ -584,7 +584,7 @@ class StepLibrary:
             )
             return jax.lax.psum(stats, DATA_AXIS)
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             per_shard,
             mesh=self.mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),

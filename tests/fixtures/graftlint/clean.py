@@ -1,0 +1,54 @@
+"""Near-miss GOOD patterns: everything here is the sanctioned form of a
+pattern some G00x rule flags — the linter must stay quiet on all of it."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# G001 good: module-scope construction, compiled once per process
+step = jax.jit(lambda p, b: (p * b).sum())
+tiny_probe = jax.jit(lambda a: a + 1.0)
+
+
+class Library:
+    def __init__(self):
+        # G001 good: __init__ is a setup scope
+        self.update = jax.jit(lambda s, g: s - 0.1 * g, donate_argnums=(0,))
+
+
+def make_ring(mesh_size):
+    # G001 good: builder idiom — callers cache the result
+    return jax.jit(lambda t: t * mesh_size)
+
+
+def timed_epoch(params, batch):
+    # G002 good: the dispatched result is blocked on inside the window
+    t0 = time.perf_counter()
+    loss = step(params, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return loss, dt
+
+
+def train_epoch(cfg, plan):
+    # G003 good: the width flows through the bucket quantizer
+    b = (cfg.batch_size // cfg.bucket) * cfg.bucket
+    x = np.zeros((b, 8), dtype=np.float32)
+    return step(jnp.float32(1.0), x)
+
+
+@jax.jit
+def good_step(params, x):
+    # G004 good: static metadata reads and lax control flow
+    scale = 1.0 / max(x.shape[0], 1)
+    return jax.lax.cond(
+        jnp.all(x > 0), lambda v: v.sum() * scale, lambda v: v.sum(), (params * x)
+    )
+
+
+def apply_update(lib, state, grads):
+    # G005 good: the donated buffer is rebound from the call's result
+    state = lib.update(state, grads)
+    return state

@@ -1,0 +1,29 @@
+"""G001 seed: jit constructed in per-call scope and in a loop body.
+
+``probe_workers`` reproduces the pre-fix form of engine.py's
+``_probe_workers`` (the round-5 dispatch-overhead probe built a fresh
+``jax.jit(lambda a: a + 1.0)`` wrapper every probe epoch, recompiling the
+tiny op each time the closure identity changed)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def probe_workers(devices):
+    # pre-fix engine.py:1478: fresh wrapper (and XLA cache entry) per call
+    tiny = jax.jit(lambda a: a + 1.0)
+    overhead = {}
+    for d in devices:
+        tx = jax.device_put(jnp.float32(0.0), d)
+        y = tiny(tx)
+        jax.block_until_ready(y)
+        overhead[d] = y
+    return overhead
+
+
+def epoch_loop(steps, x):
+    results = []
+    for _ in range(steps):
+        fn = jax.jit(lambda a: a * 2.0)  # rebuilt (and recompiled) per step
+        results.append(fn(x))
+    return results

@@ -1,0 +1,16 @@
+"""G003 seed: a raw batch-size value becomes a compiled shape.
+
+Every value of ``b`` off the bucket ladder is a fresh XLA compile inside the
+epoch — the recompile-churn contract tests/test_compile_discipline.py guards
+end-to-end."""
+
+import jax
+import numpy as np
+
+step = jax.jit(lambda x: x.sum())
+
+
+def train_epoch(cfg, n_left):
+    b = cfg.batch_size - (n_left % cfg.batch_size)  # not bucket-snapped
+    x = np.zeros((b, 32, 32, 3), dtype=np.float32)
+    return step(x)

@@ -1,0 +1,11 @@
+"""G004 seed: host coercion / Python control flow on traced values."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_step(params, x):
+    if float(x.mean()) > 0:  # branch resolved once at trace time
+        x = x - np.asarray(x).mean()  # tracer -> numpy: breaks under jit
+    return (params * x).sum()

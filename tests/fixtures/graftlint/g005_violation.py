@@ -1,0 +1,15 @@
+"""G005 seed: reading a buffer after donating it.
+
+On TPU the donated input's storage is reused for the output; the later read
+returns garbage or raises a deleted-buffer error."""
+
+import jax
+import jax.numpy as jnp
+
+update = jax.jit(lambda state, grads: state - 0.1 * grads, donate_argnums=(0,))
+
+
+def apply_update(state, grads):
+    new_state = update(state, grads)  # `state`'s buffer is donated here
+    drift = jnp.abs(state - new_state).max()  # reads the donated buffer
+    return new_state, drift

@@ -1,0 +1,195 @@
+"""Runtime compile guards (analysis/guards.py).
+
+The fast-tier half of the compile-discipline contract: the @slow e2e test in
+test_compile_discipline.py bounds the jit cache after a full run; here the
+``compile_budget()`` guard asserts the same bucket-ladder bound over two
+rebalanced epochs directly on jax.monitoring compile events — no full
+trainer loop, no cache introspection."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.guards import (
+    CompileBudgetExceeded,
+    CompileTracker,
+    compile_budget,
+    compile_count,
+)
+
+
+def _fresh_jit():
+    """A jit wrapper fresh to this call: the in-memory jit cache is
+    per-wrapper, so the first call always reaches the backend-compile path —
+    and the monitoring event fires there even on a persistent-cache hit
+    (it wraps compile_or_get_cached). The salt keeps programs distinct."""
+    salt = int.from_bytes(os.urandom(2), "little") / 65536.0
+    return jax.jit(lambda x: x * 2 + salt)
+
+
+# ----------------------------------------------------------------- unit level
+
+
+def test_budget_counts_compiles():
+    f = _fresh_jit()
+    with compile_budget(label="count") as budget:
+        f(jnp.arange(8.0))
+        f(jnp.arange(8.0))  # cached: no second compile
+    assert budget.count >= 1
+    first = budget.count
+    with compile_budget(label="recount") as budget2:
+        f(jnp.arange(8.0))  # still cached
+    assert budget2.count == 0
+    assert first >= 1
+
+
+def test_budget_exceeded_raises_with_context():
+    f = _fresh_jit()
+    with pytest.raises(CompileBudgetExceeded) as exc:
+        with compile_budget(max_compiles=0, label="strict"):
+            f(jnp.arange(4.0))
+    assert "strict" in str(exc.value)
+    assert exc.value.count >= 1
+
+
+def test_budget_warn_mode_does_not_raise():
+    class Sink:
+        messages = []
+
+        def warning(self, msg):
+            self.messages.append(msg)
+
+    sink = Sink()
+    f = _fresh_jit()
+    with compile_budget(max_compiles=0, label="soft", on_excess="warn", logger=sink):
+        f(jnp.arange(4.0))
+    assert sink.messages and "soft" in sink.messages[0]
+
+
+def test_budget_does_not_mask_region_exceptions():
+    # an exception from the region must surface as itself, not be replaced
+    # by CompileBudgetExceeded from the exit path
+    f = _fresh_jit()
+    with pytest.raises(ValueError, match="body failed"):
+        with compile_budget(max_compiles=0, label="masked"):
+            f(jnp.arange(4.0))  # over budget AND the body raises
+            raise ValueError("body failed")
+
+
+def test_identical_nested_budgets_do_not_cross_remove():
+    # two nested budgets with identical fields: the inner exit must remove
+    # ITSELF (identity), not the equal outer object — else the outer's
+    # enforcement is silently bypassed and its exit raises ValueError
+    f = _fresh_jit()
+    with pytest.raises(CompileBudgetExceeded):
+        with compile_budget(max_compiles=0) as outer:
+            with compile_budget(max_compiles=0):
+                pass  # inner compiles nothing, exits clean
+            f(jnp.arange(4.0))  # lands on OUTER only
+    assert outer.count >= 1
+
+
+def test_budgets_nest_independently():
+    f = _fresh_jit()
+    with compile_budget(label="outer") as outer:
+        g = _fresh_jit()
+        g(jnp.arange(4.0))
+        with compile_budget(label="inner") as inner:
+            f(jnp.arange(4.0))
+    assert inner.count >= 1
+    assert outer.count >= inner.count + 1  # outer saw g's compile too
+
+
+def test_tracker_drains():
+    tracker = CompileTracker()
+    try:
+        _fresh_jit()(jnp.arange(4.0))
+        n = tracker.take()
+        assert n >= 1
+        assert tracker.take() == 0  # drained
+    finally:
+        tracker.close()
+
+
+def test_compile_count_is_monotone():
+    before = compile_count()
+    _fresh_jit()(jnp.arange(4.0))
+    assert compile_count() >= before + 1
+
+
+# --------------------------------------------------- the bucket-ladder bound
+
+
+def test_two_snapped_epochs_hold_the_ladder_compile_bound(tmp_path):
+    """Two bucket-snapped DBS epochs under compile_budget():
+
+    * epoch 1 (first rebalance) may compile at most the fresh ladder rungs
+      the new plan visits — bounded by a per-worker budget;
+    * epoch 2 (converged plan, same rungs) must compile NOTHING;
+    * the worker-step executable cache never exceeds (devices x rungs).
+
+    This is the fast-tier enforcement of the contract the @slow
+    test_dbs_recompiles_bounded_by_ladder checks end-to-end. If bucket
+    snapping regresses (fractional batches, plan churn), epoch 2's zero
+    budget trips immediately.
+    """
+    from dynamic_load_balance_distributeddnn_tpu.config import Config
+    from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+    from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+    ws, batch, bucket = 4, 64, 8
+    cfg = Config(
+        debug=True,
+        world_size=ws,
+        batch_size=batch,
+        learning_rate=0.05,
+        epoch_size=4,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=True,
+        seed=5,
+        bucket=bucket,
+        warm_start=False,
+        stat_dir=str(tmp_path),
+    )
+    tr = Trainer(
+        cfg,
+        bundle=synthetic_dataset("mnist", n_train=512, n_test=64),
+        timing_model=lambda plan: np.array([3.0, 1.0, 1.0, 1.0])
+        * np.array([w.batch_size * w.steps for w in plan.workers]),
+        log_to_file=False,
+    )
+    # keep the guard test off the sharded eval path (exercised elsewhere)
+    tr.validate = lambda: (0.0, 0.0)
+
+    # epoch 0 pays the one-time anchors/instrumentation — outside the budget,
+    # like the excluded warm epoch on the TPU bench
+    tr.run_epoch(0)
+
+    # a rebalance can visit at most one fresh rung per worker; ~a handful of
+    # monitoring events per fresh executable (constants, layout twins)
+    per_rung_events = 8
+    with compile_budget(
+        max_compiles=per_rung_events * ws, label="rebalance epoch"
+    ) as rebalance:
+        tr.run_epoch(1)
+
+    # converged plan, identical rungs: recompiling ANYTHING is a regression
+    with compile_budget(max_compiles=0, label="steady epoch"):
+        tr.run_epoch(2)
+
+    # and the executable cache itself respects (used devices) x (ladder rungs)
+    max_share = min(1.0, cfg.capacity_factor / ws)
+    max_b = -(-int(np.ceil(max_share * batch)) // bucket) * bucket
+    ladder_len = len(range(bucket, max_b + 1, bucket))
+    n_used = len(tr.topology.used_device_indices)
+    step_fn = (
+        tr.steps.worker_step_first_idx
+        if tr._use_device_cache
+        else tr.steps.worker_step_first
+    )
+    assert step_fn._cache_size() <= n_used * ladder_len
+    assert rebalance.count <= per_rung_events * ws

@@ -12,7 +12,7 @@ import pytest
 
 from dynamic_load_balance_distributeddnn_tpu.config import Config
 from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
-from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import data_mesh
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import data_mesh, shard_map
 from dynamic_load_balance_distributeddnn_tpu.train import Trainer
 
 
@@ -39,7 +39,7 @@ def test_quantized_psum_is_unbiased():
             out = lib._compressed_psum(tree, jax.random.PRNGKey(key_scalar))
             return out["w"][None]
 
-        fn = jax.shard_map(
+        fn = shard_map(
             per_shard, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
             check_vma=False,
         )

@@ -1,0 +1,262 @@
+"""graftlint: every rule must trip on its seeded fixture, the sanctioned
+near-miss patterns must stay quiet, and the CLI contract must hold.
+
+(The shipped-tree-lints-clean gate lives in tests/test_lint_clean.py so a
+reintroduced G00x violation fails the default fast tier on its own.)
+"""
+
+import pathlib
+
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.analysis import (
+    Finding,
+    lint_file,
+    lint_source,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.cli import main as cli_main
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "graftlint"
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+# ------------------------------------------------------------ seeded fixtures
+
+
+@pytest.mark.parametrize(
+    "fixture,expected_code,min_findings",
+    [
+        ("g001_violation.py", "G001", 2),  # per-call scope + in-loop
+        ("g002_violation.py", "G002", 1),
+        ("g003_violation.py", "G003", 1),
+        ("g004_violation.py", "G004", 3),  # float() + np.asarray + if-branch
+        ("g005_violation.py", "G005", 1),
+    ],
+)
+def test_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
+    findings = lint_file(str(FIXTURES / fixture))
+    hits = [f for f in findings if f.code == expected_code]
+    assert len(hits) >= min_findings, (fixture, findings)
+    # a seeded fixture must not also trip unrelated rules (noise)
+    assert codes(findings) == {expected_code}, findings
+
+
+def test_g001_flags_the_pre_fix_probe_workers_form():
+    """Satellite contract: the exact engine.py:1478 bug class — a fresh
+    jax.jit(lambda a: a + 1.0) wrapper built inside the per-epoch probe —
+    must be flagged at its construction line."""
+    findings = lint_file(str(FIXTURES / "g001_violation.py"))
+    tiny_hits = [
+        f for f in findings if f.code == "G001" and "probe_workers" in f.message
+    ]
+    assert tiny_hits, findings
+    assert "tiny" in open(FIXTURES / "g001_violation.py").readlines()[
+        tiny_hits[0].line - 1
+    ]
+
+
+def test_clean_fixture_is_quiet():
+    findings = lint_file(str(FIXTURES / "clean.py"))
+    assert findings == [], [f.format() for f in findings]
+
+
+# ------------------------------------------------------------ rule mechanics
+
+
+def test_inline_suppression_comment():
+    src = (
+        "import jax\n"
+        "def hot(x):\n"
+        "    f = jax.jit(lambda a: a + 1)  # graftlint: disable=G001\n"
+        "    return f(x)\n"
+    )
+    assert lint_source(src) == []
+    # the same source without the pragma trips
+    assert codes(lint_source(src.replace("  # graftlint: disable=G001", ""))) == {
+        "G001"
+    }
+
+
+def test_g002_requires_dispatch_inside_the_window():
+    # timing host-only work is fine, even with jax imported
+    src = (
+        "import time, subprocess\n"
+        "def run(cmd):\n"
+        "    t0 = time.time()\n"
+        "    subprocess.run(cmd)\n"
+        "    return time.time() - t0\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_g002_sync_before_dispatch_does_not_count():
+    # the warm-then-time mistake: the block drains PREVIOUS work, the timed
+    # dispatch itself is never synced — must still be flagged
+    src = (
+        "import time, jax\n"
+        "step = jax.jit(lambda p, b: (p * b).sum())\n"
+        "def timed(params, b, prev):\n"
+        "    t0 = time.perf_counter()\n"
+        "    jax.block_until_ready(prev)\n"
+        "    loss = step(params, b)\n"
+        "    return loss, time.perf_counter() - t0\n"
+    )
+    assert codes(lint_source(src)) == {"G002"}
+
+
+def test_g002_sync_method_on_call_result_counts():
+    src = (
+        "import time, jax\n"
+        "step = jax.jit(lambda x: x + 1)\n"
+        "def timed(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    step(x).block_until_ready()\n"
+        "    return time.perf_counter() - t0\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_g003_bucketed_flow_is_quiet():
+    src = (
+        "import jax, numpy as np\n"
+        "step = jax.jit(lambda x: x.sum())\n"
+        "def epoch(cfg):\n"
+        "    b = (cfg.batch_size // cfg.bucket) * cfg.bucket\n"
+        "    return step(np.zeros((b, 4), np.float32))\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_g004_static_shape_reads_are_quiet():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    b = x.shape[0]\n"
+        "    if b > 4:\n"
+        "        return x.sum() / b\n"
+        "    return x.sum()\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_g004_static_argnums_params_are_not_traced():
+    src = (
+        "import functools, jax\n"
+        "@functools.partial(jax.jit, static_argnums=(1,))\n"
+        "def step(x, n):\n"
+        "    if n > 2:\n"
+        "        return x.sum() / n\n"
+        "    return x.sum()\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_g005_rebind_inside_branch_before_read_is_quiet():
+    # donate, then rebind inside a branch and read the rebound value there:
+    # the compound statement's body must not be scanned ahead of its own
+    # inner rebind
+    src = (
+        "import jax\n"
+        "f = jax.jit(lambda s: s * 2, donate_argnums=(0,))\n"
+        "def run(s, cond, g):\n"
+        "    f(s)\n"
+        "    if cond:\n"
+        "        s = g()\n"
+        "        return s\n"
+        "    return None\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_g005_rebind_in_same_statement_is_quiet():
+    src = (
+        "import jax\n"
+        "f = jax.jit(lambda s, g: s - g, donate_argnums=(0,))\n"
+        "def apply(s, g):\n"
+        "    s = f(s, g)\n"
+        "    return s\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_g005_mutually_exclusive_branches_are_quiet():
+    # donate in one If arm, read in the other: they can never both run
+    src = (
+        "import jax\n"
+        "f = jax.jit(lambda s, g: s - g, donate_argnums=(0,))\n"
+        "def apply(s, g, flag):\n"
+        "    if flag:\n"
+        "        out = f(s, g)\n"
+        "        return out\n"
+        "    else:\n"
+        "        return s\n"
+    )
+    assert lint_source(src) == []
+    # but a read AFTER the If (reachable from the donating arm) still trips
+    src2 = (
+        "import jax\n"
+        "f = jax.jit(lambda s, g: s - g, donate_argnums=(0,))\n"
+        "def apply(s, g, flag):\n"
+        "    if flag:\n"
+        "        out = f(s, g)\n"
+        "    return s\n"
+    )
+    assert codes(lint_source(src2)) == {"G005"}
+
+
+def test_finding_format_has_location_and_hint():
+    findings = lint_file(str(FIXTURES / "g002_violation.py"))
+    assert findings and isinstance(findings[0], Finding)
+    text = findings[0].format()
+    assert "g002_violation.py" in text and "G002" in text and "fix:" in text
+
+
+# ------------------------------------------------------------------- the CLI
+
+
+def test_cli_exit_codes(capsys):
+    assert cli_main([str(FIXTURES / "clean.py")]) == 0
+    assert cli_main([str(FIXTURES / "g001_violation.py")]) == 1
+    out = capsys.readouterr().out
+    assert "G001" in out and "fix:" in out
+
+
+def test_cli_select_and_list_rules(capsys):
+    # selecting an unrelated rule keeps the violation file clean
+    assert cli_main(["--select", "G005", str(FIXTURES / "g001_violation.py")]) == 0
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for code in ("G001", "G002", "G003", "G004", "G005"):
+        assert code in out
+    assert cli_main(["--select", "G999", str(FIXTURES / "clean.py")]) == 2
+
+
+def test_cli_missing_path_is_an_error(capsys):
+    # a typo'd path must not report "0 findings, exit 0" — that would turn
+    # the tier-1 lint gate permanently green
+    assert cli_main(["no_such_dir_typo_xyz"]) == 2
+    assert "no_such_dir_typo_xyz" in capsys.readouterr().err
+
+
+def test_malformed_suppression_comment_does_not_crash():
+    src = (
+        "import jax\n"
+        "def hot(x):\n"
+        "    f = jax.jit(lambda a: a + 1)  # graftlint: disable=\n"
+        "    return f(x)\n"
+    )
+    # empty code list suppresses nothing; the finding survives
+    assert codes(lint_source(src)) == {"G001"}
+
+
+def test_cli_lints_directories_recursively(capsys):
+    rc = cli_main([str(FIXTURES)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    # all five seeded violations surface in one directory walk
+    for code in ("G001", "G002", "G003", "G004", "G005"):
+        assert code in out, out

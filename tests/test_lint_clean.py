@@ -1,0 +1,24 @@
+"""Tier-1 gate: the shipped tree must lint clean.
+
+Any future PR that reintroduces a G00x violation in the package or bench.py
+fails the default fast pytest run right here — the CI half of the ISSUE-1
+contract (`graftlint dynamic_load_balance_distributeddnn_tpu bench.py`
+exits 0).
+"""
+
+import pathlib
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.cli import main as cli_main
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_shipped_tree_lints_clean(capsys):
+    rc = cli_main(
+        [
+            str(REPO / "dynamic_load_balance_distributeddnn_tpu"),
+            str(REPO / "bench.py"),
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0, f"graftlint found violations in the shipped tree:\n{out}"

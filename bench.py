@@ -263,9 +263,24 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
         # stamp the freshly measured arm so later windows never mis-attribute
         # a resumed sibling's (older) file-level stamp to it
         out.setdefault("arm_saved_at", {})[arm] = time.time()
-        for k in ("examples_per_s", "mfu_bf16_peak", "accuracy"):
+        for k in (
+            "examples_per_s",
+            "mfu_bf16_peak",
+            "accuracy",
+            # elastic-path host overhead (dispatch + put walls per step,
+            # balance/timing.py HostOverheadMeter) — the superstep lever
+            "host_overhead_per_step_s",
+        ):
             if tr.recorder.data.get(k):
                 out["instr"][f"{arm}_{k}"] = tr.recorder.data[k][-1]
+        # corrected-injection reporting: the REALIZED injected:clean
+        # device-compute profile (raw-wall-differenced calibration), printed
+        # alongside the nominal factors so a result that ran past the
+        # nominal ceiling is self-evident in the artifact
+        if tr.recorder.meta.get("realized_injection_profile") is not None:
+            out["instr"][f"{arm}_realized_injection_profile"] = tr.recorder.meta[
+                "realized_injection_profile"
+            ]
         # equal-injection-strength assertion (VERDICT r2 weak #2): the
         # in-step iteration cost must have been fixed-point calibrated on
         # the injection-free epoch, so every counted epoch runs at the
@@ -323,6 +338,50 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
         for k, v in resume.get("instr", {}).items():
             if k.startswith("clean_"):
                 out["instr"][k] = v
+        _write_atomic(out_path, out)
+
+    if (
+        force_cpu
+        and os.environ.get("BENCH_DISPATCH_AB", "1") == "1"
+        and "elastic_dispatch_ab" not in out["instr"]
+    ):
+        if resume.get("instr", {}).get("elastic_dispatch_ab"):
+            out["instr"]["elastic_dispatch_ab"] = resume["instr"][
+                "elastic_dispatch_ab"
+            ]
+        else:
+            # Dispatch-overhead A/B (ISSUE 2 acceptance): the SAME elastic
+            # plan driven through the legacy per-step loop vs the superstep
+            # path, reporting per-step host overhead (dispatch + put walls)
+            # as a field, not prose. Cheap on the CPU tier (2 short epochs
+            # per leg); the arms above already run the superstep default.
+            ab = {}
+            for label, mode in (("per_step", "off"), ("superstep", "auto")):
+                cfg = Config(
+                    debug=False,
+                    world_size=ws,
+                    batch_size=batch,
+                    learning_rate=0.01,
+                    epoch_size=2,
+                    dataset=dataset,
+                    model=model,
+                    dynamic_batch_size=True,
+                    fault_tolerance=False,
+                    bucket=bucket,
+                    precision=precision,
+                    superstep=mode,
+                )
+                tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+                for e in range(2):
+                    tr.run_epoch(e)
+                vals = tr.recorder.data.get("host_overhead_per_step_s") or []
+                if vals:
+                    # epoch 1: the uniform plan repeats epoch 0's shapes, so
+                    # the wall holds no XLA compiles — steady-state overhead
+                    ab[f"{label}_s"] = round(vals[-1], 6)
+            if ab.get("per_step_s") and ab.get("superstep_s"):
+                ab["reduction_x"] = round(ab["per_step_s"] / ab["superstep_s"], 3)
+            out["instr"]["elastic_dispatch_ab"] = ab
         _write_atomic(out_path, out)
     return 0
 
@@ -405,6 +464,11 @@ def _result_from(partial) -> dict | None:
             min(time.time(), float(partial.get("saved_at") or time.time())), 1
         ),
         "serialized_chip_ceiling": round(uniform_cost / eq_cost, 4),
+        # nominal (requested) injection profile; the REALIZED device-compute
+        # profile rides in via instr ({arm}_realized_injection_profile) so
+        # both are always printed together — a speedup past the nominal
+        # ceiling must show a realized profile that explains it
+        "nominal_injection_profile": factors,
         "dbs_off_epochs_s": partial.get("off"),
         "dbs_on_epochs_s": partial.get("on"),
         "off_steady": off,
@@ -683,6 +747,58 @@ def _try_arms(force_cpu: bool, deadline: float, retries: int) -> dict | None:
     return best
 
 
+def _result_file_path() -> str:
+    return os.environ.get(
+        "BENCH_RESULT_PATH", os.path.join("artifacts", "BENCH_result.json")
+    )
+
+
+def _write_result_file(res: dict) -> None:
+    """Best-known result mirrored to disk the moment it exists. The driver's
+    capture must survive an rc=124 kill at ANY point — round 5 shipped
+    `rc=124, parsed: null` while a fresh on-chip result sat in the cache
+    because nothing was written (or printable) until the arms finished."""
+    try:
+        os.makedirs(os.path.dirname(_result_file_path()) or ".", exist_ok=True)
+        _write_atomic(_result_file_path(), res)
+    except OSError:
+        pass
+
+
+def _preflight_seed() -> "tuple[dict | None, str]":
+    """Best result derivable from disk BEFORE any preflight/arm runs:
+    the age-bounded cached on-chip artifact, else a result assembled from a
+    completed partial (TPU first, then the CPU tier's rows). Returns
+    (result, source) with source in {"cached_tpu", "partial_tpu",
+    "partial_cpu", ""}."""
+    res = _cached_tpu_result()
+    if res is not None:
+        return res, "cached_tpu"
+    ttl = float(os.environ.get("BENCH_PARTIAL_TTL_S", 86400))
+    for tier in ("tpu", "cpu"):
+        path = os.environ.get(
+            "BENCH_PARTIAL_PATH",
+            os.path.join("artifacts", f".bench_partial_{tier}.json"),
+        )
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if (time.time() - float(prev.get("saved_at") or 0)) > ttl:
+            continue
+        res = _result_from(prev)
+        if res is not None:
+            res["detail"]["salvaged_from"] = path
+            # label from the partial's OWN backend, not the path loop: with
+            # BENCH_PARTIAL_PATH set both tiers share one file, and a CPU
+            # partial mislabeled "partial_tpu" would gate off the fresh CPU
+            # insurance arms in main()
+            src = "partial_tpu" if prev.get("backend") == "tpu" else "partial_cpu"
+            return res, src
+    return None, ""
+
+
 def _cached_tpu_result() -> dict | None:
     """Last successful ON-CHIP result, for when the tunnel is down at
     invocation time (it comes and goes for hours here). A real measured
@@ -745,8 +861,20 @@ def main() -> int:
         if _best_result is None:
             sys.stderr.write("[bench] no result obtained\n")
             return 1
+        _write_result_file(_best_result)
         print(json.dumps(_best_result), flush=True)
         return 0
+
+    # Pre-capture BEFORE the preflight ladder (which can eat the whole driver
+    # budget waiting on a wedged backend): the best disk-derivable result is
+    # written to the result file AND seeded as _best_result, so a driver
+    # timeout (SIGTERM → _emit_and_exit) or a post-mortem file read still
+    # yields this round's capture instead of `parsed: null`.
+    seeded, seed_src = _preflight_seed()
+    if seeded is not None:
+        _best_result = seeded
+        _write_result_file(seeded)
+        sys.stderr.write(f"[bench] pre-captured fallback result ({seed_src})\n")
 
     tpu_ok = False
     ladder = [
@@ -772,24 +900,29 @@ def main() -> int:
         if (
             i == 0
             and insurance_on
-            and _best_result is None
+            # a pre-seeded CPU-partial result is stale by definition — a
+            # fresh insurance run still beats it; only a real on-chip
+            # capture makes the insurance not worth its wall-clock
+            and (_best_result is None or seed_src == "partial_cpu")
             and _cached_tpu_result() is None
         ):
-            # no cached on-chip result to fall back on — only then is the
-            # insurance run worth its wall-clock
             sys.stderr.write("[bench] running CPU insurance arms\n")
-            _best_result = _try_arms(
+            fresh = _try_arms(
                 force_cpu=True,
                 deadline=min(time.time() + 1500, deadline),
                 retries=1,
             )
+            if fresh is not None:
+                _best_result, seed_src = fresh, ""
+                _write_result_file(_best_result)
         i += 1
         time.sleep(30)
 
     if tpu_ok:
         res = _try_arms(force_cpu=False, deadline=deadline, retries=retries)
         if res is not None:
-            _best_result = res  # a TPU number beats any insurance
+            _best_result = res  # a TPU number beats any insurance/seed
+            _write_result_file(_best_result)
     if _best_result is None or _best_result.get("detail", {}).get("backend") != "tpu":
         cached = _cached_tpu_result()
         if cached is not None:
@@ -806,6 +939,7 @@ def main() -> int:
     if _best_result is None:
         sys.stderr.write("[bench] no result obtained\n")
         return 1
+    _write_result_file(_best_result)
     print(json.dumps(_best_result), flush=True)
     return 0
 

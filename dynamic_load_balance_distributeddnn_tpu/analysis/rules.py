@@ -1,4 +1,4 @@
-"""graftlint rules G001-G005.
+"""graftlint rules G001-G006.
 
 Each rule encodes one structural TPU/JAX perf-bug class this repo has
 actually shipped (the motivating incident is listed in README "Static
@@ -52,6 +52,12 @@ KNOWN_STEP_ATTRS = {
     "worker_step_acc",
     "worker_step_first_idx",
     "worker_step_acc_idx",
+    "worker_step_first_win",
+    "worker_step_acc_win",
+    "worker_step_first_win_idx",
+    "worker_step_acc_win_idx",
+    "group_superstep",
+    "group_superstep_idx",
     "combine_update",
     "combine_probe",
     "fused_step",
@@ -72,6 +78,10 @@ KNOWN_DONOR_ATTRS: Dict[str, Tuple[int, ...]] = {
     "fused_epoch_idx": (0,),
     "worker_step_acc": (1,),
     "worker_step_acc_idx": (1,),
+    "worker_step_acc_win": (1,),
+    "worker_step_acc_win_idx": (1,),
+    "group_superstep": (0,),
+    "group_superstep_idx": (0,),
 }
 
 _CLOCK_CALLS = {
@@ -110,9 +120,13 @@ _TRACE_ENTRY_TAILS = (
     "lax.switch",
 )
 
-# Names whose presence in an expression marks its value as living on the
-# bucketed shape ladder (G003): the planner/quantizer surface plus the
-# engine's capacity-width properties.
+# Names whose presence in an expression marks its value as living on a
+# sanctioned shape discipline (G003). Vision: the bucket ladder (planner/
+# quantizer surface plus the engine's capacity-width properties). LM/SP
+# (ISSUE 2 satellite — the rule used to model only the vision ladder): the
+# column-batch/bptt-window channel — shapes must flow through batchify/
+# bptt_windows (window length discipline, pad_bsz column padding) or
+# shard_tokens (the SP mesh split), not reach a compiled shape raw.
 _BUCKET_MARKERS = {
     "bucket",
     "snap_to_bucket",
@@ -124,8 +138,15 @@ _BUCKET_MARKERS = {
     "cap_packed",
     "padded_batch",
     "pad_to",
+    # LM/SP discipline channels
+    "batchify",
+    "bptt_windows",
+    "pad_bsz",
+    "shard_tokens",
 }
-_BATCH_SOURCES = {"batch_size"}
+# Raw shape-determining values: the global batch knob and the solver's raw
+# per-worker split (LM column counts derive from it before padding).
+_BATCH_SOURCES = {"batch_size", "batch_sizes"}
 
 _SHAPE_BUILDERS = {
     "np.zeros",
@@ -888,6 +909,90 @@ class RuleG005:
                             break
 
 
+# --------------------------------------------------------------------------
+# G006 — per-step device_put interleaved with dispatch in a hot loop
+
+
+class RuleG006:
+    code = "G006"
+    summary = "per-step jax.device_put interleaved with compiled dispatch in a loop"
+    fix_hint = (
+        "hoist the transfer out of the step loop: stage the whole window "
+        "once per window (train/pipeline.py WindowTransferPipeline, or a "
+        "single [win, ...] put sliced on device) so host→device traffic "
+        "overlaps compute instead of serializing with every dispatch"
+    )
+
+    # Setup/instrumentation scopes where a per-iteration put alongside a
+    # dispatch is the point (warm ladders, probe/calibration passes) — the
+    # rule targets hot TRAINING loops, not one-off epochs of measurement.
+    _ALLOWED_NAMES = {"__init__", "__post_init__", "setup"}
+    _ALLOWED_PREFIXES = (
+        "warm", "_warm",
+        "build", "_build",
+        "make_", "_make",
+        "create_", "_create",
+        "probe", "_probe",
+        "calibrate", "_calibrate",
+    )
+
+    _PUT_TAILS = {"device_put", "device_put_sharded", "device_put_replicated"}
+
+    def _scope_allowed(self, fn: Optional[ast.AST]) -> bool:
+        if fn is None or isinstance(fn, ast.Lambda):
+            return fn is None  # module-scope loops are setup by definition
+        name = fn.name
+        return name in self._ALLOWED_NAMES or name.startswith(
+            self._ALLOWED_PREFIXES
+        )
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        jit_bound = _jit_bound_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and _attr_tail(call_name(node)) in self._PUT_TAILS
+            ):
+                continue
+            fn = _innermost_function(node, ctx.parents)
+            if self._scope_allowed(fn):
+                continue
+            loop = enclosing_loop(node, ctx.parents, stop_at=fn)
+            if loop is None:
+                continue
+            # the INNERMOST loop containing the put must itself dispatch a
+            # compiled executable: per-window staging loops (puts only, the
+            # dispatch lives in a sibling loop) are the sanctioned idiom
+            dispatches = [
+                c
+                for c in ast.walk(loop)
+                if isinstance(c, ast.Call)
+                and _is_dispatch_call(c, jit_bound)
+                and enclosing_loop(c, ctx.parents, stop_at=fn) is loop
+                and _innermost_function(c, ctx.parents) is fn
+            ]
+            if not dispatches:
+                continue
+            yield _finding(
+                self.code,
+                ctx,
+                node,
+                f"`{call_name(node)}` inside the same loop as the compiled "
+                f"dispatch `{call_name(dispatches[0]) or '<jit>'}` — a "
+                "host→device transfer is issued every iteration of a "
+                "scan-capable step loop",
+                self.fix_hint,
+            )
+
+
 RULES: Dict[str, object] = {
-    r.code: r for r in (RuleG001(), RuleG002(), RuleG003(), RuleG004(), RuleG005())
+    r.code: r
+    for r in (
+        RuleG001(),
+        RuleG002(),
+        RuleG003(),
+        RuleG004(),
+        RuleG005(),
+        RuleG006(),
+    )
 }

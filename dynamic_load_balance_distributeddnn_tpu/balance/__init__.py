@@ -5,6 +5,7 @@ from dynamic_load_balance_distributeddnn_tpu.balance.solver import (
     rebalance_py,
 )
 from dynamic_load_balance_distributeddnn_tpu.balance.timing import (
+    HostOverheadMeter,
     TimeKeeper,
     exchange_times,
 )
@@ -14,6 +15,7 @@ __all__ = [
     "integer_batch_split",
     "rebalance",
     "rebalance_py",
+    "HostOverheadMeter",
     "TimeKeeper",
     "exchange_times",
 ]

@@ -250,6 +250,30 @@ class Config:
     process_id: int = -1               # multi-host: this process's id; -1
                                        # lets JAX autodetect (TPU pods).
                                        # Env: DBS_PROCESS_ID.
+    superstep: str = "auto"            # "auto"|"on"|"off": elastic-path
+                                       # supersteps (ISSUE 2). auto/on: the
+                                       # elastic hot loop runs windowed — a
+                                       # single-device worker group executes
+                                       # a whole window as ONE compiled
+                                       # lax.scan (combine cadence inside the
+                                       # scan, bitwise-identical math), and
+                                       # multi-device groups dispatch one
+                                       # window-sliced executable per worker
+                                       # per step (on-device step slicing)
+                                       # behind a per-device double-buffered
+                                       # transfer pipeline. off: the legacy
+                                       # per-step dispatch loop (kept as the
+                                       # parity/overhead reference).
+    superstep_window: int = 16         # scan-mode superstep window cap: the
+                                       # compiled window is a fully UNROLLED
+                                       # scan (a rolled while-loop lowers
+                                       # with different reduction blocking
+                                       # and breaks bitwise parity with the
+                                       # per-step path), so program size and
+                                       # compile time scale with the window;
+                                       # 16 already amortizes dispatch 16x.
+                                       # Windowed (multi-device) mode streams
+                                       # by stream_chunk_steps as before.
     packed: str = "auto"               # "auto"|"on"|"off": single-device
                                        # packed epochs — when every worker
                                        # lives on ONE chip (the contention
@@ -287,6 +311,10 @@ class Config:
             raise ValueError("device_cache must be 'auto', 'on' or 'off'")
         if self.packed not in ("auto", "on", "off"):
             raise ValueError("packed must be 'auto', 'on' or 'off'")
+        if self.superstep not in ("auto", "on", "off"):
+            raise ValueError("superstep must be 'auto', 'on' or 'off'")
+        if self.superstep_window < 1:
+            raise ValueError("superstep_window must be >= 1")
         if self.compress_grads and self.dynamic_batch_size and not self.fused_dbs:
             raise ValueError(
                 "compress_grads rides a fused path (the elastic DBS combine "
@@ -438,6 +466,16 @@ def get_parser() -> argparse.ArgumentParser:
                         "index (on-device gather): per-epoch reshard costs an "
                         "index upload instead of re-transferring the dataset.")
     p.add_argument("--device_cache_mb", type=int, default=d.device_cache_mb)
+    p.add_argument("--superstep", type=str, default=d.superstep,
+                   choices=["auto", "on", "off"],
+                   help="Elastic-path supersteps: windowed executables (one "
+                        "compiled scan per window on single-device groups) "
+                        "plus the per-device double-buffered transfer "
+                        "pipeline; off = legacy per-step dispatch.")
+    p.add_argument("--superstep_window", type=int, default=d.superstep_window,
+                   help="Max steps per compiled superstep window (scan mode "
+                        "unrolls fully for bitwise parity; compile time "
+                        "scales with this).")
     p.add_argument("--packed", type=str, default=d.packed,
                    choices=["auto", "on", "off"],
                    help="Single-device packed epochs: concat all workers' "

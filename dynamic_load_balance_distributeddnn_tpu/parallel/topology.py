@@ -53,6 +53,21 @@ class WorkerTopology:
     def one_worker_per_device(self) -> bool:
         return self.world_size == len(self.devices) and len(self.groups) == self.world_size
 
+    @property
+    def single_group(self) -> bool:
+        """Every logical worker lives on ONE device (the reference's full
+        contention map, -gpu 0,0,0,0). This is the topology where a per-step
+        cross-worker gradient combine is local to one chip, so the elastic
+        superstep scan (train/steps.py) can carry the optimizer update inside
+        one compiled window and stay bitwise-identical to per-step dispatch."""
+        return len(self.groups) == 1
+
+    def group_shape_key(self, padded_batches: Sequence[int], window: int) -> Tuple:
+        """Cache identity of one device group's superstep executable:
+        (window length, each worker's bucketed batch in dispatch order).
+        The engine's compile-once sentinel keys on this."""
+        return (int(window),) + tuple(int(b) for b in padded_batches)
+
     def contention_factor(self, rank: int) -> int:
         """How many workers share this worker's device."""
         return len(self.groups[self.worker_device[rank]])

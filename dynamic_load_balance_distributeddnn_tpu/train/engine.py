@@ -34,6 +34,7 @@ import numpy as np
 
 from dynamic_load_balance_distributeddnn_tpu.analysis.guards import CompileTracker
 from dynamic_load_balance_distributeddnn_tpu.balance import (
+    HostOverheadMeter,
     TimeKeeper,
     exchange_times,
     initial_partition,
@@ -64,6 +65,9 @@ from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import replicated_sha
 from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import heartbeat
 from dynamic_load_balance_distributeddnn_tpu.train.schedule import one_cycle_lr
 from dynamic_load_balance_distributeddnn_tpu.train.state import create_state, make_optimizer
+from dynamic_load_balance_distributeddnn_tpu.train.pipeline import (
+    WindowTransferPipeline,
+)
 from dynamic_load_balance_distributeddnn_tpu.train.steps import (
     StepLibrary,
     shard_views,
@@ -247,6 +251,12 @@ class Trainer:
         self._use_device_cache = self._decide_device_cache()
         self._cache_repl = None
         self._cache_dev: Dict[int, tuple] = {}
+        # Elastic-superstep bookkeeping: host-overhead meter (dispatch vs put
+        # walls, reset per epoch) and the (shape-tuple, window) keys the scan
+        # mode has dispatched — the compile-once sentinel the CompileTracker
+        # warning is cross-checked against (run_epoch).
+        self._host_meter = HostOverheadMeter()
+        self._superstep_keys: set = set()
         if cfg.packed == "on":
             # fail fast at init: the epoch dispatch prefers the fused paths,
             # so a forced-but-infeasible packed config would otherwise be
@@ -442,10 +452,142 @@ class Trainer:
                     acc, aux = step_acc(views[d], acc, *args)
                 jax.block_until_ready(aux)
                 heartbeat()  # one ladder compile done — the watchdog's unit
+        n_win = self._warm_windowed_shapes(ladder, views, warm_acc)
+        n_win += self._warm_superstep_shapes()
         self.logger.info(
             f"Warm start: compiled {len(ladder)} batch shapes "
-            f"(up to {max_b}) in {time.perf_counter() - t0:.1f}s"
+            f"(up to {max_b}, + {n_win} windowed/superstep variants) in "
+            f"{time.perf_counter() - t0:.1f}s"
         )
+
+    def _warm_windowed_shapes(self, ladder, views, warm_acc: bool) -> int:
+        """Warm the window-sliced executables the superstep hot loop actually
+        dispatches (the per-step ladder above still serves the probes). The
+        window lengths come from a representative epoch-0 plan — the
+        equal-step invariant keeps num_steps (and so the body/tail window
+        lengths) constant across rebalanced plans, so (rung, window) covers
+        the epochs' compiled-shape universe. Scan mode is excluded: its
+        executables specialize on whole shape TUPLES (combinatorial — they
+        compile lazily, once per (shapes, window), sentinel-checked)."""
+        if self._elastic_mode() != "window":
+            return 0
+        cfg = self.cfg
+        plan0 = self._build_plan(0, integer_batch_split(self.shares, cfg.batch_size))
+        wins = sorted({s1 - s0 for s0, s1 in self._elastic_ranges(plan0.num_steps)})
+        use_cache = self._use_device_cache
+        key = jax.random.PRNGKey(0)
+        slow = jnp.int32(0)
+        s0_i = np.int32(0)
+        n = 0
+        for d in self.topology.used_device_indices:
+            dev = self.topology.devices[d]
+            cache = self._device_cache_for(d) if use_cache else ()
+            for b in ladder:
+                x, y, w = self._dummy_batch(b)
+                for win in wins:
+                    kwin = jax.device_put(jax.random.split(key, win), dev)
+                    ww = jax.device_put(np.broadcast_to(w, (win,) + w.shape).copy(), dev)
+                    if use_cache:
+                        args = cache + (
+                            jax.device_put(np.zeros((win, b), np.int32), dev),
+                            ww,
+                            kwin,
+                            s0_i,
+                            jax.device_put(slow, dev),
+                        )
+                        step_first = self.steps.worker_step_first_win_idx
+                        step_acc = self.steps.worker_step_acc_win_idx
+                    else:
+                        args = (
+                            jax.device_put(np.broadcast_to(x, (win,) + x.shape).copy(), dev),
+                            jax.device_put(np.broadcast_to(y, (win,) + y.shape).copy(), dev),
+                            ww,
+                            kwin,
+                            s0_i,
+                            jax.device_put(slow, dev),
+                        )
+                        step_first = self.steps.worker_step_first_win
+                        step_acc = self.steps.worker_step_acc_win
+                    acc, aux = step_first(views[d], *args)
+                    if warm_acc:
+                        acc, aux = step_acc(views[d], acc, *args)
+                    jax.block_until_ready(aux)
+                    n += 1
+                    heartbeat()
+        return n
+
+    def _warm_superstep_shapes(self) -> int:
+        """Scan-mode warm: compile the epoch-0 (uniform) plan's superstep
+        (shape-tuple, window) keys against a zeros dummy state (donated and
+        discarded), so the run's opening epochs pay no unrolled-scan compile
+        inside a timed wall. Rebalanced plans' fresh shape TUPLES are
+        combinatorial and still compile lazily, once per key — warmed keys
+        register in ``_superstep_keys`` so the compile-once sentinel's
+        cache-vs-keys comparison stays exact."""
+        if self._elastic_mode() != "scan":
+            return 0
+        cfg = self.cfg
+        plan0 = self._build_plan(0, integer_batch_split(self.shares, cfg.batch_size))
+        wins = sorted({s1 - s0 for s0, s1 in self._elastic_ranges(plan0.num_steps)})
+        topo = self.topology
+        d0 = topo.used_device_indices[0]
+        group = topo.groups[d0]
+        dev = topo.devices[d0]
+        use_cache = self._use_device_cache
+        key = jax.random.PRNGKey(0)
+        n = 0
+        for win in wins:
+            padded = [plan0.workers[self.rank_lo + r].padded_batch for r in group]
+            self._superstep_keys.add(topo.group_shape_key(padded, win))
+            cols = []
+            for b in padded:
+                x, y, w = self._dummy_batch(b)
+                kwin = jax.device_put(jax.random.split(key, win), dev)
+                ww = jax.device_put(
+                    np.broadcast_to(w, (win,) + w.shape).copy(), dev
+                )
+                if use_cache:
+                    cols.append((
+                        jax.device_put(np.zeros((win, b), np.int32), dev),
+                        ww,
+                        kwin,
+                    ))
+                else:
+                    cols.append((
+                        jax.device_put(np.broadcast_to(x, (win,) + x.shape).copy(), dev),
+                        jax.device_put(np.broadcast_to(y, (win,) + y.shape).copy(), dev),
+                        ww,
+                        kwin,
+                    ))
+            tup = tuple(zip(*cols))
+            slows = tuple(jax.device_put(jnp.int32(0), dev) for _ in group)
+            # the dummy must replicate the REAL state's shardings AND
+            # committed-ness, not just shapes/dtypes: zeros_like drops the
+            # NamedSharding, and committing a leaf the real state leaves
+            # uncommitted (the injected-hyperparams lr scalar) changes the
+            # pjit signature either way — the mismatch compiles a second,
+            # never-reused superstep variant
+            def zero_like(t):
+                z = jnp.zeros(t.shape, t.dtype)
+                if getattr(t, "_committed", True):
+                    z = jax.device_put(z, t.sharding)
+                return z
+
+            dummy = jax.tree_util.tree_map(zero_like, self.state)
+            if use_cache:
+                idxs, ws_, ks = tup
+                _, aux = self.steps.group_superstep_idx(
+                    dummy, *self._device_cache_for(d0), idxs, ws_, ks, slows
+                )
+            else:
+                xs, ys, ws_, ks = tup
+                _, aux = self.steps.group_superstep(
+                    dummy, xs, ys, ws_, ks, slows
+                )
+            jax.block_until_ready(aux)
+            n += 1
+            heartbeat()
+        return n
 
     def run(self, epochs: Optional[int] = None) -> MetricsRecorder:
         cfg = self.cfg
@@ -663,6 +805,20 @@ class Trainer:
         # always recorded (0.0 on probe-free epochs) so the series stays
         # index-aligned with the per-epoch series in the saved artifact
         extras["probe_time"] = probe_s
+        # elastic-path host-overhead walls (superstep A/B instrumentation;
+        # absent on the fused paths, whose dispatch is one scan per window)
+        for k in ("host_dispatch_s", "host_put_s", "host_overhead_per_step_s"):
+            if k in train_metrics:
+                extras[k] = train_metrics[k]
+        # Corrected-injection reporting (compute-mode A/B hygiene): alongside
+        # the NOMINAL straggler profile (meta straggler_factors), stamp the
+        # REALIZED injected:clean device-compute profile derived from the
+        # raw-wall-differenced calibration quantities, so an artifact whose
+        # realized profile drifted past the nominal ceiling is self-evident.
+        if self._needs_iter_cost:
+            prof = self._realized_injection_profile(plan, faults)
+            if prof is not None:
+                self.recorder.meta["realized_injection_profile"] = prof
         if epoch_wall > 0:
             extras["examples_per_s"] = self.n_train / epoch_wall
         ppe = self._flops_per_padded_example
@@ -686,9 +842,13 @@ class Trainer:
         # every epoch so the series stays aligned.
         # the layout must capture every compiled-shape dimension a plan
         # controls: padded widths AND the step counts (fused window shapes
-        # carry plan.num_steps / per-worker steps in their leading dims)
-        plan_layout = (int(plan.num_steps),) + tuple(
-            (int(w.padded_batch), int(w.steps)) for w in plan.workers
+        # carry plan.num_steps / per-worker steps in their leading dims) AND
+        # the streaming window lengths (superstep/windowed executables
+        # specialize on them — ISSUE 2's (shape, window) cache key)
+        plan_layout = (
+            (int(plan.num_steps),)
+            + tuple((int(w.padded_batch), int(w.steps)) for w in plan.workers)
+            + tuple(s1 - s0 for s0, s1 in self._elastic_ranges(plan.num_steps))
         )
         layout_seen = plan_layout in self._seen_plan_layouts
         self._seen_plan_layouts.add(plan_layout)
@@ -821,6 +981,38 @@ class Trainer:
                 else 0.0
             )
             self.timekeeper.add_compute(r, (clean + inj) * w_plan.steps)
+
+    def _realized_injection_profile(self, plan, faults: EpochFaults):
+        """Per-worker REALIZED injected:clean device-compute multipliers for
+        compute-mode injection: (clean_r + iter_cost * slow_r) / clean_r.
+        Both ingredients are RTT-immune by construction — the in-step
+        iteration cost comes from PAIRED raw-wall differencing (the 0.2*dt
+        correction floor cancels in the pair, _probe_workers/_calibrate_
+        iter_cost) and the clean anchor from the dispatch-overhead-corrected
+        standalone walls — so this is the profile the A/B actually ran at,
+        not the nominal request. None until both anchors exist.
+
+        Single-host only: the anchors are per-process and a collective gated
+        on locally-measured finiteness could deadlock the allgather (the
+        multi-host artifact keeps the nominal profile alone)."""
+        if self.n_proc > 1:
+            return None
+        lo, hi = self.rank_lo, self.rank_lo + self.ws_local
+        if not np.isfinite(self.per_example_cost[lo:hi]).all():
+            return None
+        iter_cost = self._iter_cost_s
+        if iter_cost is None:
+            return None
+        prof = np.ones(self.cfg.world_size, dtype=np.float64)
+        for r in range(lo, hi):
+            clean = float(self.per_example_cost[r]) * max(
+                plan.workers[r].batch_size, 1
+            )
+            if clean <= 0:
+                return None
+            inj = iter_cost * float(faults.slow_iters_per_step[r])
+            prof[r] = (clean + inj) / clean
+        return [round(float(p), 4) for p in prof]
 
     def _update_probe_schedule(
         self, epoch: int, plan, faults: EpochFaults, epoch_wall: float,
@@ -986,6 +1178,21 @@ class Trainer:
         if chunk <= 0 or num_steps <= chunk:
             return [(0, num_steps)]
         return [(s, min(s + chunk, num_steps)) for s in range(0, num_steps, chunk)]
+
+    def _elastic_ranges(self, num_steps: int):
+        """Elastic-path step windows. Scan mode additionally caps windows at
+        ``superstep_window``: the superstep compiles a fully UNROLLED window
+        (bitwise parity with per-step dispatch requires the unrolled
+        lowering — steps.py group_superstep), so program size must stay
+        bounded. Still at most two distinct window lengths per geometry."""
+        ranges = self._chunk_ranges(num_steps)
+        if self._elastic_mode() != "scan":
+            return ranges
+        win = max(int(self.cfg.superstep_window), 1)
+        out = []
+        for s0, s1 in ranges:
+            out.extend((s, min(s + win, s1)) for s in range(s0, s1, win))
+        return out
 
     def _gather_fused_window(self, plan, s0: int, s1: int, pad_to=None,
                              as_indices: bool = False, pack_total=None):
@@ -1282,15 +1489,108 @@ class Trainer:
             w = np.pad(w, pad1)
         return x, y, w
 
+    def _elastic_mode(self) -> str:
+        """How the elastic hot loop executes (config.superstep):
+
+        ``"scan"`` — ONE device hosts every worker (the full contention
+        topology), so the per-step cross-worker combine is chip-local and a
+        whole window runs as one compiled ``lax.scan`` carrying the
+        TrainState: one dispatch per window, bitwise-identical math.
+
+        ``"window"`` — workers span several devices, so step k's combine is
+        a mesh collective that step k+1's gradients depend on; the per-step
+        cadence stays, but each worker-step is ONE window-sliced executable
+        call (on-device step indexing) instead of ~5 host-issued dispatches.
+
+        ``"step"`` — the legacy per-step loop (superstep="off"), kept as the
+        bitwise-parity and dispatch-overhead reference."""
+        if self.cfg.superstep == "off":
+            return "step"
+        if self.topology.single_group and self.n_proc == 1:
+            return "scan"
+        return "window"
+
+    def _dispatch_superstep_window(
+        self, staged_d: Dict, d: int, group, win_key, slow_dev, aux_windows
+    ) -> None:
+        """Scan mode: one compiled superstep for the whole worker group's
+        window. ``staged_d[r]`` holds worker r's window arrays (+ rng keys);
+        the per-worker tuples transpose into the scan's pytree inputs."""
+        cols = tuple(zip(*(staged_d[r] for r in group)))
+        slows = tuple(slow_dev[r] for r in group)
+        self._superstep_keys.add(win_key)
+        with self._host_meter.dispatch():
+            if self._use_device_cache:
+                idxs, ws_, ks = cols
+                self.state, aux = self.steps.group_superstep_idx(
+                    self.state, *self._device_cache_for(d), idxs, ws_, ks, slows
+                )
+            else:
+                xs, ys, ws_, ks = cols
+                self.state, aux = self.steps.group_superstep(
+                    self.state, xs, ys, ws_, ks, slows
+                )
+        aux_windows.append(aux)
+
+    def _dispatch_combine_steps(
+        self, staged: Dict, win: int, slow_dev, aux_acc, windowed: bool
+    ) -> None:
+        """Per-step combine cadence, shared by window mode and the legacy
+        per-step mode (superstep="off" — the dispatch-overhead reference the
+        superstep A/B in bench.py measures against). ``windowed`` picks how
+        a worker-step gets its data: ONE window-sliced executable call (the
+        step index rides in as a traced scalar, the window slices on device)
+        vs host-side slicing plus the single-step executables (one dispatch
+        per slice)."""
+        topo = self.topology
+        steps = self.steps
+        use_cache = self._use_device_cache
+        if windowed:
+            step_first = steps.worker_step_first_win_idx if use_cache else steps.worker_step_first_win
+            step_acc = steps.worker_step_acc_win_idx if use_cache else steps.worker_step_acc_win
+        else:
+            step_first = steps.worker_step_first_idx if use_cache else steps.worker_step_first
+            step_acc = steps.worker_step_acc_idx if use_cache else steps.worker_step_acc
+        for s in range(win):
+            s_i = np.int32(s)
+            with self._host_meter.dispatch():
+                partials = {}
+                views = shard_views(self.state.params, topo.devices)
+                for d in topo.used_device_indices:
+                    acc = None
+                    cache = self._device_cache_for(d) if use_cache else ()
+                    for r in topo.groups[d]:
+                        arrs = staged[d][r]
+                        if windowed:
+                            args = cache + arrs + (s_i, slow_dev[r])
+                        else:
+                            args = cache + tuple(a[s] for a in arrs) + (
+                                slow_dev[r],
+                            )
+                        if acc is None:
+                            acc, aux = step_first(views[d], *args)
+                        else:
+                            acc, aux = step_acc(views[d], acc, *args)
+                        aux_acc.append(aux)
+                    partials[d] = acc
+                stacked = stack_partials(
+                    [partials[d] for d in topo.used_device_indices], self.mesh
+                )
+                self.state = self.steps.combine_update(self.state, stacked)
+
     def _train_epoch_elastic(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
         topo = self.topology
         self.timekeeper.reset()
+        mode = self._elastic_mode()
+        meter = self._host_meter
+        meter.reset()
 
         # Local topo ranks r (0..ws_local-1) own global worker rank_lo + r.
         groups = topo.groups
         dev_order = topo.used_device_indices
         aux_acc: List = []
+        aux_windows: List = []  # scan mode: [win, n_workers, 4] per window
         sync_probe = 0.0
         base_key = jax.random.PRNGKey(cfg.seed * 7919 + epoch)
         wkeys = jax.random.split(base_key, cfg.world_size * max(plan.num_steps, 1))
@@ -1317,61 +1617,67 @@ class Trainer:
                     jnp.int32(faults.slow_iters_per_step[gr]), dev
                 )
 
-        # Streaming host path: window k+1 gathers on the prefetch thread while
-        # window k's steps dispatch (async). Each window transfers ONCE per
-        # worker ([win, b_pad, ...] put); steps slice on-device. Window-local
+        ranges = self._elastic_ranges(plan.num_steps)
+
+        def stage_window(d: int, i: int, data):
+            """One device's puts for one window: each worker's arrays plus
+            that window's absolute-step rng keys. Runs on the pipeline's
+            per-device threads, concurrently across devices and with the
+            controller's dispatch of the previous window."""
+            w0, w1 = ranges[i]
+            dev = topo.devices[d]
+            staged = {}
+            for r in groups[d]:
+                gr = self.rank_lo + r
+                kwin = wkeys[np.arange(w0, w1) * cfg.world_size + gr]
+                staged[r] = tuple(
+                    jax.device_put(a, dev) for a in data[r]
+                ) + (jax.device_put(kwin, dev),)
+            return staged
+
+        # Streaming host path, double-buffered per device: window k+1's host
+        # gather AND its per-device puts run on the transfer pipeline while
+        # window k dispatches/executes (train/pipeline.py). Window-local
         # rows, absolute-step rng keys — identical math to the whole-epoch
-        # gather.
-        ranges = self._chunk_ranges(plan.num_steps)
+        # gather. Peak host memory: two windows, not the epoch.
         first_data = None
-        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
-            fut = pool.submit(gather_window, *ranges[0])
+        with WindowTransferPipeline(
+            ranges, gather_window, stage_window, dev_order, meter=meter
+        ) as pipe:
             for i, (w0, w1) in enumerate(ranges):
-                data = fut.result()
-                if i + 1 < len(ranges):
-                    fut = pool.submit(gather_window, *ranges[i + 1])
+                data, staged = pipe.get(i)
                 if first_data is None:
                     first_data = data
-                staged_win = {}
-                for d in dev_order:
-                    dev = topo.devices[d]
-                    for r in groups[d]:
-                        gr = self.rank_lo + r
-                        kwin = wkeys[
-                            np.arange(w0, w1) * cfg.world_size + gr
-                        ]
-                        staged_win[r] = tuple(
-                            jax.device_put(a, dev) for a in data[r]
-                        ) + (jax.device_put(kwin, dev),)
-                for s_abs in range(w0, w1):
-                    s = s_abs - w0
-                    partials = {}
-                    views = shard_views(self.state.params, self.topology.devices)
-                    for d in dev_order:
-                        acc = None
-                        cache = self._device_cache_for(d) if use_cache else None
-                        for r in groups[d]:
-                            if use_cache:
-                                iw, ww, kw = staged_win[r]
-                                args = cache + (iw[s], ww[s], kw[s], slow_dev[r])
-                                step_first = self.steps.worker_step_first_idx
-                                step_acc = self.steps.worker_step_acc_idx
-                            else:
-                                xw, yw, ww, kw = staged_win[r]
-                                args = (xw[s], yw[s], ww[s], kw[s], slow_dev[r])
-                                step_first = self.steps.worker_step_first
-                                step_acc = self.steps.worker_step_acc
-                            if acc is None:
-                                acc, aux = step_first(views[d], *args)
-                            else:
-                                acc, aux = step_acc(views[d], acc, *args)
-                            aux_acc.append(aux)
-                        partials[d] = acc
-
-                    stacked = stack_partials(
-                        [partials[d] for d in dev_order], self.mesh
+                if mode == "scan":
+                    d0 = dev_order[0]
+                    win_key = topo.group_shape_key(
+                        [plan.workers[self.rank_lo + r].padded_batch
+                         for r in groups[d0]],
+                        w1 - w0,
                     )
-                    self.state = self.steps.combine_update(self.state, stacked)
+                    self._dispatch_superstep_window(
+                        staged[d0], d0, groups[d0], win_key, slow_dev,
+                        aux_windows,
+                    )
+                else:
+                    self._dispatch_combine_steps(
+                        staged, w1 - w0, slow_dev, aux_acc,
+                        windowed=(mode == "window"),
+                    )
+        if mode == "scan":
+            # flatten the scanned aux back into the per-step path's exact
+            # (step, worker) row order so the float64 metric summation below
+            # reproduces per-step results bit for bit
+            for aux in aux_windows:
+                aux_acc.extend(np.asarray(aux, dtype=np.float64).reshape(-1, 4))
+            cache_n = self.steps.superstep_cache_size()
+            if cache_n > len(self._superstep_keys):
+                self.logger.warning(
+                    f"Epoch {epoch}: {cache_n} compiled superstep variants "
+                    f"exceed the {len(self._superstep_keys)} dispatched "
+                    "(shape, window) keys — a superstep input fell off its "
+                    "static layout (graftlint G003/G006)"
+                )
         data = first_data  # probes below reuse the first window's batches
 
         jax.block_until_ready(self.state.params)
@@ -1458,6 +1764,13 @@ class Trainer:
             # accounts it under total_probe_s / the probe_time series —
             # do NOT subtract it again anywhere downstream
             "dbs_probe_cost": dbs_probe_cost,
+            # host-side cost of driving the epoch (enqueue + transfer walls,
+            # balance/timing.py HostOverheadMeter) — the quantity the
+            # superstep path exists to shrink; bench.py reports the
+            # per-step value as its dispatch-overhead A/B field
+            "host_dispatch_s": meter.dispatch_s,
+            "host_put_s": meter.put_s,
+            "host_overhead_per_step_s": meter.per_step(plan.num_steps),
         }
 
     def _probe_workers(

@@ -1,0 +1,94 @@
+"""Per-device double-buffered host→device window pipeline (ISSUE 2).
+
+The elastic path used to drive its streaming windows with a single
+``max_workers=1`` prefetch thread: window k+1's host gather overlapped the
+device, but every ``jax.device_put`` was then issued serially from the
+controller thread, in the middle of the dispatch loop. Here each window
+flows through two stages on a shared thread pool:
+
+1. **gather** — one task per window materializes the host arrays
+   (numpy row-pack, or index/weight arrays in device-cache mode);
+2. **stage** — one task PER LOCAL DEVICE issues that device's puts as soon
+   as the gather lands, concurrently across devices and concurrently with
+   the controller thread dispatching window k.
+
+``get(i)`` blocks only on window i's staged buffers and immediately launches
+window i+1, so steady state keeps exactly two windows in flight (peak host
+memory: two windows, as before). Transfer walls are reported to the
+:class:`~...balance.timing.HostOverheadMeter` from the staging threads, so
+the engine's dispatch walls never include them.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class WindowTransferPipeline:
+    """Double-buffered (gather → per-device put) pipeline over step windows.
+
+    ``ranges``: the epoch's ``(s0, s1)`` windows, in execution order.
+    ``gather``: ``gather(s0, s1) -> data`` host materialization.
+    ``stage``: ``stage(device_index, window_index, data) -> staged`` issues
+    one device's puts for one window and returns the device buffers.
+    ``device_indices``: the device indices ``stage`` is fanned out over.
+    """
+
+    def __init__(
+        self,
+        ranges: Sequence[Tuple[int, int]],
+        gather: Callable,
+        stage: Callable,
+        device_indices: Sequence[int],
+        meter=None,
+    ):
+        self._ranges = list(ranges)
+        self._gather = gather
+        self._stage = stage
+        self._devices = list(device_indices)
+        self._meter = meter
+        # one slot per device puts + one for the gather of the next window
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=len(self._devices) + 1
+        )
+        self._inflight: Dict[int, Tuple] = {}
+
+    def _stage_device(self, d: int, i: int, gather_fut) -> object:
+        data = gather_fut.result()
+        t0 = time.perf_counter()
+        staged = self._stage(d, i, data)
+        if self._meter is not None:
+            self._meter.add_put_s(time.perf_counter() - t0)
+        return staged
+
+    def _launch(self, i: int) -> None:
+        if i in self._inflight or not (0 <= i < len(self._ranges)):
+            return
+        gather_fut = self._pool.submit(self._gather, *self._ranges[i])
+        put_futs = {
+            d: self._pool.submit(self._stage_device, d, i, gather_fut)
+            for d in self._devices
+        }
+        self._inflight[i] = (gather_fut, put_futs)
+
+    def get(self, i: int) -> Tuple[object, Dict[int, object]]:
+        """Window i's ``(host_data, {device_index: staged})``; prefetches
+        window i+1 before blocking so its gather+puts overlap window i's
+        execution."""
+        self._launch(i)
+        self._launch(i + 1)
+        gather_fut, put_futs = self._inflight.pop(i)
+        staged = {d: f.result() for d, f in put_futs.items()}
+        return gather_fut.result(), staged
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "WindowTransferPipeline":
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self.close()
+        return None

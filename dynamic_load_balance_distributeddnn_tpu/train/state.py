@@ -34,7 +34,16 @@ class TrainState:
 
     def with_learning_rate(self, lr: float) -> "TrainState":
         hp = dict(self.opt_state.hyperparams)
-        hp["learning_rate"] = jnp.asarray(lr, dtype=jnp.float32)
+        new = jnp.asarray(lr, dtype=jnp.float32)
+        old = hp["learning_rate"]
+        # Preserve the old leaf's placement: a bare jnp.asarray is an
+        # UNCOMMITTED array, which changes the state's pjit signature (the
+        # replicated NamedSharding becomes UnspecifiedValue) and silently
+        # forks a second compiled variant of every executable the state
+        # feeds — the engine's warm-start work would never be reused.
+        if getattr(old, "_committed", False):
+            new = jax.device_put(new, old.sharding)
+        hp["learning_rate"] = new
         return self.replace(opt_state=self.opt_state._replace(hyperparams=hp))
 
 

@@ -197,6 +197,71 @@ class StepLibrary:
 
         self.worker_step_first = worker_step_first
         self.worker_step_acc = worker_step_acc
+        # shared forward/backward closure, reused by the windowed and
+        # superstep executables built lazily below
+        self._local_grads = local_grads
+
+        # Windowed twins: the whole staged window rides in once per window and
+        # each call slices its step ON DEVICE (lax.dynamic_index_in_dim on a
+        # traced step index), so a worker-step dispatch is ONE executable call
+        # instead of one call plus 4 host-issued slice dispatches. The jit
+        # cache specializes per (window length, bucketed batch) — the
+        # superstep cache key of ISSUE 2 — and per device via the committed
+        # inputs. Math after the slice is byte-for-byte local_grads.
+        def _win_slice(s, *arrays):
+            return tuple(
+                jax.lax.dynamic_index_in_dim(a, s, 0, keepdims=False)
+                for a in arrays
+            )
+
+        @jax.jit
+        def worker_step_first_win(params, xw, yw, ww, kw, s, slow_iters):
+            x, y, w, rng = _win_slice(s, xw, yw, ww, kw)
+            g, wloss, loss_sum, count, probe = local_grads(
+                params, x, y, w, rng, slow_iters, rng
+            )
+            acc = jax.tree_util.tree_map(lambda t: t[None], g)
+            return acc, (wloss, loss_sum, count, probe)
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def worker_step_acc_win(params, acc, xw, yw, ww, kw, s, slow_iters):
+            x, y, w, rng = _win_slice(s, xw, yw, ww, kw)
+            g, wloss, loss_sum, count, probe = local_grads(
+                params, x, y, w, rng, slow_iters, rng
+            )
+            acc = jax.tree_util.tree_map(lambda a, t: a + t[None], acc, g)
+            return acc, (wloss, loss_sum, count, probe)
+
+        @jax.jit
+        def worker_step_first_win_idx(
+            params, train_x, train_y, iw, ww, kw, s, slow_iters
+        ):
+            idx, w, rng = _win_slice(s, iw, ww, kw)
+            x = jnp.take(train_x, idx, axis=0, mode="clip")
+            y = jnp.take(train_y, idx, axis=0, mode="clip")
+            g, wloss, loss_sum, count, probe = local_grads(
+                params, x, y, w, rng, slow_iters, rng
+            )
+            acc = jax.tree_util.tree_map(lambda t: t[None], g)
+            return acc, (wloss, loss_sum, count, probe)
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def worker_step_acc_win_idx(
+            params, acc, train_x, train_y, iw, ww, kw, s, slow_iters
+        ):
+            idx, w, rng = _win_slice(s, iw, ww, kw)
+            x = jnp.take(train_x, idx, axis=0, mode="clip")
+            y = jnp.take(train_y, idx, axis=0, mode="clip")
+            g, wloss, loss_sum, count, probe = local_grads(
+                params, x, y, w, rng, slow_iters, rng
+            )
+            acc = jax.tree_util.tree_map(lambda a, t: a + t[None], acc, g)
+            return acc, (wloss, loss_sum, count, probe)
+
+        self.worker_step_first_win = worker_step_first_win
+        self.worker_step_acc_win = worker_step_acc_win
+        self.worker_step_first_win_idx = worker_step_first_win_idx
+        self.worker_step_acc_win_idx = worker_step_acc_win_idx
 
         # Index-fed twins for the device-resident data cache: the train
         # arrays live in HBM; each step gathers its rows on device, so the
@@ -255,6 +320,97 @@ class StepLibrary:
             return state.replace(params=params, opt_state=opt_state, step=state.step + 1)
 
         self.combine_probe = combine_probe
+
+    # -------------------------------------------------- elastic superstep
+    # (engine._train_epoch_elastic, ISSUE 2). One dispatch per WINDOW for a
+    # whole device group: a lax.scan over the window's steps whose body
+    # replays the per-step path's exact op sequence — each worker's
+    # local_grads at its true bucketed shape, the [1,...]-stacked left-fold
+    # accumulation, sum over the stacked axis, tx.update, apply — so the
+    # result is bitwise-identical to per-step dispatch. Only valid when the
+    # group spans EVERY worker (single-device topologies): with workers on
+    # several devices, step k's gradients need step k-1's cross-device
+    # combine, which no single-device scan can contain.
+
+    def _superstep_body(self, state: TrainState, xs, ys, ws_, ks, slows):
+        """One scanned step for a whole worker group: tuples hold one entry
+        per worker, each at its own (static) bucketed shape."""
+        acc = None
+        aux = []
+        for i in range(len(ws_)):
+            g, wloss, loss_sum, count, probe = self._local_grads(
+                state.params, xs[i], ys[i], ws_[i], ks[i], slows[i], ks[i]
+            )
+            if acc is None:
+                acc = jax.tree_util.tree_map(lambda t: t[None], g)
+            else:
+                acc = jax.tree_util.tree_map(lambda a, t: a + t[None], acc, g)
+            aux.append(jnp.stack([wloss, loss_sum, count, probe]))
+        grads = jax.tree_util.tree_map(lambda t: jnp.sum(t, axis=0), acc)
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        state = state.replace(
+            params=params, opt_state=opt_state, step=state.step + 1
+        )
+        return state, jnp.stack(aux)
+
+    @functools.cached_property
+    def group_superstep(self):
+        """Materialized-feed superstep: carry = the full TrainState (the
+        per-step combine cadence lives INSIDE the scan); scanned inputs are
+        per-worker (x, y, w) windows plus the per-step rng keys — the same
+        wkeys table the per-step path consumes, so the rng stream is
+        identical. Returns (state, aux[win, n_workers, 4])."""
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def superstep(state, xs, ys, ws_, ks, slows):
+            def body(st, inp):
+                return self._superstep_body(st, *inp, slows)
+
+            # unroll=True: a rolled scan lowers to a while-loop whose body
+            # XLA emits with different reduction blocking than the
+            # standalone executables — measurably (~1e-8) off the per-step
+            # path. Fully unrolled, the window compiles to the same op
+            # sequence and the bitwise-parity contract holds; the engine
+            # bounds the unroll length via config.superstep_window.
+            return jax.lax.scan(body, state, (xs, ys, ws_, ks), unroll=True)
+
+        return superstep
+
+    @functools.cached_property
+    def group_superstep_idx(self):
+        """Device-cache-fed superstep: the HBM-resident train arrays ride in
+        whole (no re-transfer) and each scanned step gathers each worker's
+        rows by index on device — the host ships [win, b_pad] int32 per
+        worker instead of the batches."""
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def superstep(state, train_x, train_y, idxs, ws_, ks, slows):
+            def body(st, inp):
+                iw, ws_s, ks_s = inp
+                xs = tuple(
+                    jnp.take(train_x, i, axis=0, mode="clip") for i in iw
+                )
+                ys = tuple(
+                    jnp.take(train_y, i, axis=0, mode="clip") for i in iw
+                )
+                return self._superstep_body(st, xs, ys, ws_s, ks_s, slows)
+
+            # unroll=True: see group_superstep — bitwise parity requires the
+            # unrolled lowering
+            return jax.lax.scan(body, state, (idxs, ws_, ks), unroll=True)
+
+        return superstep
+
+    def superstep_cache_size(self) -> int:
+        """Compiled (shape-tuple, window-length) superstep variants — the
+        quantity the compile-once contract (tests/test_superstep.py) bounds."""
+        n = 0
+        for name in ("group_superstep", "group_superstep_idx"):
+            fn = self.__dict__.get(name)
+            if fn is not None:
+                n += fn._cache_size()
+        return n
 
     # ------------------------------------------------------------ fused path
     # (evaluation is always the sharded fused_eval_step — there is no

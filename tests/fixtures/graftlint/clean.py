@@ -52,3 +52,32 @@ def apply_update(lib, state, grads):
     # G005 good: the donated buffer is rebound from the call's result
     state = lib.update(state, grads)
     return state
+
+
+def lm_epoch(cfg, stream, bptt_windows, batchify):
+    # G003 good (LM/SP discipline): the column count flows through the
+    # batchify/bptt_windows channel before any compiled shape sees it
+    data = batchify(stream, cfg.batch_size)
+    xs, ys, ms = bptt_windows(data, cfg.bptt)
+    return step(jnp.float32(1.0), xs[0])
+
+
+def windowed_epoch(params, windows, dev):
+    # G006 good: the window stages ONCE in its own loop; the step loop only
+    # dispatches (the transfer-pipeline idiom)
+    total = 0.0
+    for win in windows:
+        staged = []
+        for arr in win:
+            staged.append(jax.device_put(arr, dev))
+        for x in staged:
+            total += step(params, x)
+    return total
+
+
+def warm_shapes(params, ladder, dev):
+    # G006 good: warm/setup scopes pre-compile the ladder — a put per rung
+    # alongside the dispatch is the point
+    for b in ladder:
+        x = jax.device_put(np.zeros((b, 8), np.float32), dev)
+        step(params, x)

@@ -1,8 +1,14 @@
-"""G003 seed: a raw batch-size value becomes a compiled shape.
+"""G003 seeds: a raw batch-size value becomes a compiled shape.
 
-Every value of ``b`` off the bucket ladder is a fresh XLA compile inside the
-epoch — the recompile-churn contract tests/test_compile_discipline.py guards
-end-to-end."""
+Every value off the sanctioned shape discipline is a fresh XLA compile inside
+the epoch — the recompile-churn contract tests/test_compile_discipline.py
+guards end-to-end. Two shapes of the bug:
+
+* vision: a batch width that never passed the bucket ladder
+  (snap_to_bucket/quantize_batches);
+* LM/SP: a raw per-worker column split that never passed the
+  batchify/bptt_windows/pad_bsz channel (the column-count discipline).
+"""
 
 import jax
 import numpy as np
@@ -13,4 +19,12 @@ step = jax.jit(lambda x: x.sum())
 def train_epoch(cfg, n_left):
     b = cfg.batch_size - (n_left % cfg.batch_size)  # not bucket-snapped
     x = np.zeros((b, 32, 32, 3), dtype=np.float32)
+    return step(x)
+
+
+def lm_epoch(cfg, batch_sizes, rank):
+    # raw solver split used as a column count: off the batchify/pad_bsz
+    # channel, so every rebalance compiles a fresh column width
+    cols = batch_sizes[rank]
+    x = np.zeros((cols, 35), dtype=np.int32)
     return step(x)

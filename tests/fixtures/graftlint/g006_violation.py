@@ -1,0 +1,17 @@
+"""G006 seed: a host→device transfer issued EVERY step of a hot loop that
+also dispatches a compiled executable — the transfer serializes with the
+dispatch queue instead of overlapping compute (the pattern the elastic
+superstep/transfer-pipeline rework removed; stage the window once instead).
+"""
+
+import jax
+
+step = jax.jit(lambda p, x: (p * x).sum())
+
+
+def train_epoch(params, batches, dev):
+    total = 0.0
+    for b in batches:
+        x = jax.device_put(b, dev)  # per-step put in the dispatch loop
+        total += step(params, x)
+    return total

@@ -31,9 +31,10 @@ def codes(findings):
     [
         ("g001_violation.py", "G001", 2),  # per-call scope + in-loop
         ("g002_violation.py", "G002", 1),
-        ("g003_violation.py", "G003", 1),
+        ("g003_violation.py", "G003", 2),  # vision ladder + LM column split
         ("g004_violation.py", "G004", 3),  # float() + np.asarray + if-branch
         ("g005_violation.py", "G005", 1),
+        ("g006_violation.py", "G006", 1),
     ],
 )
 def test_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -61,6 +62,64 @@ def test_g001_flags_the_pre_fix_probe_workers_form():
 def test_clean_fixture_is_quiet():
     findings = lint_file(str(FIXTURES / "clean.py"))
     assert findings == [], [f.format() for f in findings]
+
+
+def test_g003_lm_discipline_channel_is_quiet():
+    """The LM/SP sanction channel: a column count flowing through
+    batchify/bptt_windows (or pad_bsz) is on-discipline even though it
+    derives from batch_size — the 'vision-only scoping' is gone without
+    the rule going noisy on the LM engines."""
+    src = (
+        "import jax\n"
+        "step = jax.jit(lambda x: x.sum())\n"
+        "def lm_epoch(cfg, stream, batchify, bptt_windows):\n"
+        "    data = batchify(stream, cfg.batch_size)\n"
+        "    xs, ys, m = bptt_windows(data, cfg.bptt, pad_bsz=cfg.batch_size)\n"
+        "    return step(xs[0])\n"
+    )
+    assert lint_source(src) == []
+    # the same column count reaching a shape builder RAW still trips
+    raw = (
+        "import jax\n"
+        "import numpy as np\n"
+        "step = jax.jit(lambda x: x.sum())\n"
+        "def lm_epoch(cfg, batch_sizes, rank):\n"
+        "    cols = batch_sizes[rank]\n"
+        "    x = np.zeros((cols, 35), dtype=np.int32)\n"
+        "    return step(x)\n"
+    )
+    assert codes(lint_source(raw)) == {"G003"}
+
+
+def test_g006_window_staging_loop_is_quiet():
+    """The sanctioned idiom: transfers staged once per window in their own
+    loop, dispatch in a sibling (or nested) loop — only a put in the SAME
+    innermost loop as a dispatch is the per-step bug."""
+    src = (
+        "import jax\n"
+        "step = jax.jit(lambda p, x: (p * x).sum())\n"
+        "def epoch(params, windows, dev):\n"
+        "    total = 0.0\n"
+        "    for win in windows:\n"
+        "        staged = [jax.device_put(a, dev) for a in win]\n"
+        "        for x in staged:\n"
+        "            total += step(params, x)\n"
+        "    return total\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_g006_warm_scope_is_quiet():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "step = jax.jit(lambda p, x: (p * x).sum())\n"
+        "def _warm_shapes(params, ladder, dev):\n"
+        "    for b in ladder:\n"
+        "        x = jax.device_put(np.zeros((b, 8), np.float32), dev)\n"
+        "        step(params, x)\n"
+    )
+    assert lint_source(src) == []
 
 
 # ------------------------------------------------------------ rule mechanics

@@ -72,8 +72,11 @@ def test_packed_engages_and_matches_elastic_partitions(bundle):
     assert tr_p.steps.worker_step_acc_idx._cache_size() == 0
     # one fixed concat width -> at most body+tail scan geometries
     assert tr_p.steps.fused_epoch_idx._cache_size() <= 2
-    # elastic run on the same topology did use the elastic loop
-    assert tr_e.steps.worker_step_first_idx._cache_size() >= 1
+    # elastic run on the same topology did use the elastic loop — since the
+    # superstep rework that is the group scan (one dispatch per window; the
+    # deterministic timing model also models the probes out, so the
+    # single-step executables never dispatch at all)
+    assert tr_e.steps.superstep_cache_size() >= 1
 
 
 def test_packed_dbs_off_single_device(bundle):

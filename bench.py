@@ -22,8 +22,13 @@ init can block 50+ minutes and then fail UNAVAILABLE):
    backend claim), writing per-epoch walls incrementally; a crash mid-run
    leaves salvageable partials. Retries shrink BENCH_NTRAIN (compile cache
    persists across attempts via JAX_COMPILATION_CACHE_DIR).
-4. EARLY EXIT — SIGTERM/SIGINT print the best result so far before dying, so
-   a driver-side kill still yields a parsed line.
+4. EARLY EXIT — SIGTERM/SIGINT print the best result so far before dying,
+   AND every improvement (including the pre-preflight disk-derived seed) is
+   printed as a JSON line the moment it exists, so even an unhandleable
+   SIGKILL mid-ladder leaves the best-so-far as the final parsed line.
+5. AOT WARM A/B — the CPU tier also measures the serial execute-to-compile
+   warm wall vs the concurrent AOT compile service (`aot_warm_ab` field,
+   dedicated subprocess with per-program-serial codegen; ISSUE 3).
 
 Instrumentation: examples/s and MFU (obs/flops.py, XLA cost model vs chip
 bf16 peak) from the trainer's recorder extras, reported in `detail`.
@@ -44,7 +49,20 @@ import sys
 import tempfile
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "./.jax_cache")
+# Persistent XLA compilation cache, shared by EVERY subprocess this file
+# spawns (preflight attempts, arm runs, retries across shrink levels): the
+# path is made absolute (a child changing cwd must not fork the cache) and
+# the min-compile-time/entry-size floors are zeroed so preflight's tiny
+# matmul and the small CPU-tier programs persist too — preflight attempt 2
+# used to recompile everything attempt 1 had already paid for.
+_cache_dir = os.path.abspath(os.environ.get("JAX_COMPILATION_CACHE_DIR") or "./.jax_cache")
+try:
+    os.makedirs(_cache_dir, exist_ok=True)
+except OSError:
+    pass
+os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 
 _best_result = None  # orchestrator's best-known JSON dict
 
@@ -383,6 +401,156 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
                 ab["reduction_x"] = round(ab["per_step_s"] / ab["superstep_s"], 3)
             out["instr"]["elastic_dispatch_ab"] = ab
         _write_atomic(out_path, out)
+
+    if (
+        force_cpu
+        and os.environ.get("BENCH_AOT_AB", "1") == "1"
+        and "aot_warm_ab" not in out["instr"]
+    ):
+        if resume.get("instr", {}).get("aot_warm_ab"):
+            out["instr"]["aot_warm_ab"] = resume["instr"]["aot_warm_ab"]
+        else:
+            # Serial-vs-concurrent warm A/B (ISSUE 3 acceptance) in a
+            # dedicated subprocess: it needs its own XLA flags (4-device CPU
+            # mesh + per-program-serial codegen) and a disabled persistent
+            # cache, neither of which can change after this process's
+            # backend initialized.
+            fd, ab_path = tempfile.mkstemp(suffix=".json")
+            os.close(fd)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+            proc = None
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--aot-ab",
+                     "--out", ab_path],
+                    capture_output=True,
+                    text=True,
+                    timeout=float(os.environ.get("BENCH_AOT_AB_TIMEOUT", 900)),
+                    env=env,
+                )
+                with open(ab_path) as f:
+                    ab = json.load(f)
+                # the child writes incrementally: a crash mid-leg leaves a
+                # syntactically-valid partial — only adopt a COMPLETE A/B
+                # (speedup present) or an explicit error marker
+                if proc.returncode == 0 and ("speedup_x" in ab or "error" in ab):
+                    out["instr"]["aot_warm_ab"] = ab
+                else:
+                    sys.stderr.write(
+                        f"[bench] aot_warm_ab incomplete (rc={proc.returncode}, "
+                        f"keys={sorted(ab)}); dropped\n"
+                    )
+            except Exception as e:
+                # a crash before the child's first write leaves an empty
+                # file (JSONDecodeError lands here) — the child's stderr is
+                # the only post-mortem, keep it
+                sys.stderr.write(f"[bench] aot_warm_ab failed: {e}\n")
+            finally:
+                if proc is not None and proc.returncode != 0 and proc.stderr:
+                    sys.stderr.write(proc.stderr[-800:] + "\n")
+                try:
+                    os.unlink(ab_path)
+                except OSError:
+                    pass
+        _write_atomic(out_path, out)
+    return 0
+
+
+def run_aot_ab(out_path: str) -> int:
+    """Serial execute-to-compile vs concurrent AOT warm-start A/B (the
+    ISSUE-3 acceptance field ``aot_warm_ab``). Runs in its own subprocess:
+    the parent pins a 4-device CPU mesh (both legs see identical XLA
+    flags), and the persistent compilation cache is disabled so BOTH legs
+    pay real backend compiles — equal compile counts is the fairness
+    condition.
+
+    Leg A (``--aot_warm off``): the legacy warm — compile by executing dummy
+    steps, serially, with per-rung device_put traffic. Leg B: the AOT
+    service — lower(abstract).compile() jobs on the thread pool. Same
+    config, same ladder, fresh StepLibrary per leg (no in-memory reuse).
+
+    What the delta measures: the execute-to-compile tax — the dummy
+    EXECUTIONS (a ResNet forward+backward at warm rungs costs ~2x the
+    compile itself on this tier), the per-rung host→device transfers, and
+    GIL-serial tracing that the AOT leg pipelines under backend compiles.
+    Concurrent conv-program compiles contend ~fully on this 2-core tier
+    (measured: jobs overlap 2x but stretch 2x), so the CPU-tier speedup is
+    a LOWER bound for backends/hosts whose compilers scale across cores."""
+    done = _install_init_watchdog()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.devices()
+    done.set()
+    # authoritative regardless of inherited env: both legs recompile for real
+    jax.config.update("jax_enable_compilation_cache", False)
+
+    from dynamic_load_balance_distributeddnn_tpu.analysis.guards import (
+        compile_budget,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.config import Config
+    from dynamic_load_balance_distributeddnn_tpu.data import load_dataset
+    from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+    n_train = int(os.environ.get("BENCH_AOT_AB_NTRAIN", 1024))
+    bundle = load_dataset("cifar10", n_train=n_train, n_test=256)
+    out = {}
+    for label, aot in (("serial_execute", False), ("concurrent_aot", True)):
+        # ResNet-18 on the CIFAR shape: the model family where warm-rung
+        # dummy executions genuinely dominate (the bench's DenseNet ladder
+        # burned 15-40 min of tunnel window exactly this way). ws=2 and
+        # capacity_factor=1.0 keep the ladder at 2 rungs (64/128) so the AB
+        # finishes in ~2 min on the CPU tier.
+        cfg = Config(
+            debug=False,
+            world_size=2,
+            batch_size=256,
+            learning_rate=0.01,
+            epoch_size=1,
+            dataset="cifar10",
+            model="resnet18",
+            dynamic_batch_size=True,
+            bucket=64,
+            capacity_factor=1.0,
+            warm_start=True,
+            aot_warm=aot,
+        )
+        tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+        t0 = time.perf_counter()
+        with compile_budget(label=label, include_background=True) as budget:
+            tr._maybe_warm()
+            if tr._aot is not None:
+                failures = tr._aot.wait()
+                if failures:
+                    # top-level marker too: the parent only adopts a file
+                    # carrying speedup_x or an explicit error
+                    out["error"] = f"{label}: {len(failures)} compile jobs failed"
+                    out[label] = {"error": out["error"]}
+                    break
+        out[label] = {
+            "warm_wall_s": round(time.perf_counter() - t0, 3),
+            "compile_events": budget.count,
+        }
+        if tr._aot is not None:
+            st = tr._aot.stats()
+            out[label]["jobs"] = int(st["compiled"])
+            out[label]["pool"] = tr._aot._workers
+        _write_atomic(out_path, out)
+    ser = out.get("serial_execute", {}).get("warm_wall_s")
+    con = out.get("concurrent_aot", {}).get("warm_wall_s")
+    if ser and con:
+        out["speedup_x"] = round(ser / con, 3)
+        # the fairness condition: both legs compiled the same program set
+        out["equal_compile_counts"] = (
+            abs(
+                out["serial_execute"]["compile_events"]
+                - out["concurrent_aot"]["compile_events"]
+            )
+            <= 0.1 * out["serial_execute"]["compile_events"] + 2
+        )
+    _write_atomic(out_path, out)
     return 0
 
 
@@ -765,6 +933,20 @@ def _write_result_file(res: dict) -> None:
         pass
 
 
+def _publish(res: dict) -> None:
+    """Adopt ``res`` as the best-known result AND print it as a JSON line
+    NOW. The driver parses the LAST JSON line on stdout, so publishing every
+    improvement the moment it exists guarantees the best disk-derivable
+    result is already emitted before the preflight ladder / arms can eat the
+    budget — an rc=124 kill at ANY later point (even SIGKILL after the
+    grace, where the SIGTERM handler never runs) still leaves a parsed
+    line. A better result printed later simply becomes the new last line."""
+    global _best_result
+    _best_result = res
+    _write_result_file(res)
+    print(json.dumps(res), flush=True)
+
+
 def _preflight_seed() -> "tuple[dict | None, str]":
     """Best result derivable from disk BEFORE any preflight/arm runs:
     the age-bounded cached on-chip artifact, else a result assembled from a
@@ -837,6 +1019,8 @@ def main() -> int:
     global _best_result
     if "--preflight" in sys.argv:
         return run_preflight()
+    if "--aot-ab" in sys.argv:
+        return run_aot_ab(sys.argv[sys.argv.index("--out") + 1])
     if "--arms" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
         resume = (
@@ -857,23 +1041,22 @@ def main() -> int:
     insurance_on = os.environ.get("BENCH_CPU_INSURANCE", "1") == "1"
 
     if force_cpu:
-        _best_result = _try_arms(force_cpu=True, deadline=deadline, retries=retries)
-        if _best_result is None:
+        res = _try_arms(force_cpu=True, deadline=deadline, retries=retries)
+        if res is None:
             sys.stderr.write("[bench] no result obtained\n")
             return 1
-        _write_result_file(_best_result)
-        print(json.dumps(_best_result), flush=True)
+        _publish(res)
         return 0
 
     # Pre-capture BEFORE the preflight ladder (which can eat the whole driver
     # budget waiting on a wedged backend): the best disk-derivable result is
-    # written to the result file AND seeded as _best_result, so a driver
-    # timeout (SIGTERM → _emit_and_exit) or a post-mortem file read still
-    # yields this round's capture instead of `parsed: null`.
+    # written to the result file AND EMITTED as a parsed JSON line right
+    # away, so a driver kill at any later point — SIGTERM (handled) or
+    # SIGKILL (not handleable) — still leaves this round's best capture as
+    # the final parsed line instead of `parsed: null`.
     seeded, seed_src = _preflight_seed()
     if seeded is not None:
-        _best_result = seeded
-        _write_result_file(seeded)
+        _publish(seeded)
         sys.stderr.write(f"[bench] pre-captured fallback result ({seed_src})\n")
 
     tpu_ok = False
@@ -913,16 +1096,15 @@ def main() -> int:
                 retries=1,
             )
             if fresh is not None:
-                _best_result, seed_src = fresh, ""
-                _write_result_file(_best_result)
+                seed_src = ""
+                _publish(fresh)
         i += 1
         time.sleep(30)
 
     if tpu_ok:
         res = _try_arms(force_cpu=False, deadline=deadline, retries=retries)
         if res is not None:
-            _best_result = res  # a TPU number beats any insurance/seed
-            _write_result_file(_best_result)
+            _publish(res)  # a TPU number beats any insurance/seed
     if _best_result is None or _best_result.get("detail", {}).get("backend") != "tpu":
         cached = _cached_tpu_result()
         if cached is not None:
@@ -931,16 +1113,16 @@ def main() -> int:
                 f"cached on-chip result ({cached['detail']['cached_age_s']:.0f}s old, "
                 f"{cached['detail']['cached_from']})\n"
             )
-            _best_result = cached
+            _publish(cached)
     if _best_result is None and insurance_on:
-        _best_result = _try_arms(
+        res = _try_arms(
             force_cpu=True, deadline=max(deadline, time.time() + 900), retries=1
         )
+        if res is not None:
+            _publish(res)
     if _best_result is None:
         sys.stderr.write("[bench] no result obtained\n")
         return 1
-    _write_result_file(_best_result)
-    print(json.dumps(_best_result), flush=True)
     return 0
 
 

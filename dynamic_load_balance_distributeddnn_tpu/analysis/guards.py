@@ -17,6 +17,17 @@ listener fans those events out to:
 The listener registers lazily on first use and is never unregistered
 (jax.monitoring has no public unregister; an idle listener costs one function
 call per compile, i.e. nothing).
+
+**Background (AOT) compiles.** The async compile service
+(runtime/compiler.py) deliberately compiles on pool threads while epochs
+execute; its threads are named with :data:`AOT_THREAD_PREFIX`, and the
+listener runs on the compiling thread, so events can be attributed. Budgets
+and trackers default to counting only *foreground* compiles — the ones on
+the execution path, which is what the recompile sentinel and the
+steady-epoch zero-budgets police — and opt into background events with
+``include_background=True`` (the warm-ladder CI guard and the bench's
+serial-vs-concurrent warm A/B, which must see equal compile counts on both
+legs).
 """
 
 from __future__ import annotations
@@ -29,9 +40,15 @@ from typing import Iterator, List, Optional
 
 _COMPILE_EVENT_PREFIX = "/jax/core/compile/backend_compile"
 
+# Compile-pool threads are named with this prefix; runtime/compiler.py
+# imports it from here (single definition — a drift would silently count
+# background compiles as foreground and trip every steady-epoch budget).
+AOT_THREAD_PREFIX = "jax-aot-compile"
+
 _lock = threading.Lock()
 _installed = False
 _total_compiles = 0
+_total_bg_compiles = 0
 _active_budgets: List["CompileBudget"] = []
 # Weak registry: consumers (one tracker per Trainer) drop out when their
 # owner is garbage-collected, so a process that builds many engines (bench
@@ -40,15 +57,22 @@ _trackers: "weakref.WeakSet[CompileTracker]" = weakref.WeakSet()
 
 
 def _on_event(event: str, duration: float = 0.0, **_kw) -> None:
-    global _total_compiles
+    global _total_compiles, _total_bg_compiles
     if not event.startswith(_COMPILE_EVENT_PREFIX):
         return
+    # the listener runs ON the compiling thread, so the thread name tells
+    # foreground (execution path) from background (AOT service pool) apart
+    background = threading.current_thread().name.startswith(AOT_THREAD_PREFIX)
     with _lock:
         _total_compiles += 1
+        if background:
+            _total_bg_compiles += 1
         for budget in _active_budgets:
-            budget.count += 1
+            if not background or budget.include_background:
+                budget.count += 1
         for tracker in _trackers:
-            tracker._pending += 1
+            if not background or tracker.include_background:
+                tracker._pending += 1
 
 
 def _ensure_listener() -> None:
@@ -67,12 +91,21 @@ def _ensure_listener() -> None:
 
 
 def compile_count() -> int:
-    """Total XLA backend compiles observed since the listener was installed.
-    Call once early (e.g. at trainer init) if you intend to diff against it —
-    compiles before installation are not counted."""
+    """Total XLA backend compiles observed since the listener was installed
+    (foreground AND background). Call once early (e.g. at trainer init) if
+    you intend to diff against it — compiles before installation are not
+    counted."""
     _ensure_listener()
     with _lock:
         return _total_compiles
+
+
+def background_compile_count() -> int:
+    """Compiles observed on AOT-service pool threads (a subset of
+    :func:`compile_count`)."""
+    _ensure_listener()
+    with _lock:
+        return _total_bg_compiles
 
 
 class CompileBudgetExceeded(RuntimeError):
@@ -95,6 +128,7 @@ class CompileBudget:   # match a different-but-equal nested budget
     label: str
     max_compiles: Optional[int]
     count: int = 0
+    include_background: bool = False
 
 
 @contextmanager
@@ -103,6 +137,7 @@ def compile_budget(
     label: str = "compile_budget",
     on_excess: str = "raise",
     logger=None,
+    include_background: bool = False,
 ) -> Iterator[CompileBudget]:
     """Count XLA backend compiles over a region; enforce a bound on exit.
 
@@ -113,11 +148,20 @@ def compile_budget(
     backend compile in the region — internal helper ops (jnp constant
     uploads etc.) too — so budgets should carry a few entries of slack
     rather than an exact executable count.
+
+    ``include_background``: also count compiles from the AOT compile
+    service's pool threads (runtime/compiler.py). Off by default — a
+    steady-epoch zero-budget polices the *execution path*, and deliberate
+    overlapped background compiles (speculation) would fail it spuriously.
     """
     if on_excess not in ("raise", "warn"):
         raise ValueError(f"on_excess must be 'raise' or 'warn', got {on_excess!r}")
     _ensure_listener()
-    budget = CompileBudget(label=label, max_compiles=max_compiles)
+    budget = CompileBudget(
+        label=label,
+        max_compiles=max_compiles,
+        include_background=include_background,
+    )
     with _lock:
         _active_budgets.append(budget)
     clean_exit = False
@@ -153,9 +197,12 @@ class CompileTracker:
     ``take()`` returns the number of backend compiles since the previous
     ``take()`` and resets the pending count — the engine calls it at each
     epoch boundary and logs a warning when steady-state epochs (probes
-    anchored, ladder warm) still compile."""
+    anchored, ladder warm) still compile. Background AOT-service compiles
+    are excluded by default (``include_background``): they are deliberate
+    overlapped work, not a shape falling off the ladder."""
 
     _pending: int = field(default=0, repr=False)
+    include_background: bool = field(default=False)
 
     def __post_init__(self) -> None:
         _ensure_listener()

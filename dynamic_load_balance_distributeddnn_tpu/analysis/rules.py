@@ -1,4 +1,4 @@
-"""graftlint rules G001-G006.
+"""graftlint rules G001-G007.
 
 Each rule encodes one structural TPU/JAX perf-bug class this repo has
 actually shipped (the motivating incident is listed in README "Static
@@ -985,14 +985,150 @@ class RuleG006:
             )
 
 
+# --------------------------------------------------------------------------
+# G007 — execute-to-compile warm loops / blocking compile in a timed region
+
+
+class RuleG007:
+    code = "G007"
+    summary = (
+        "execute-to-compile warm loop, or blocking .compile() inside a "
+        "timed region"
+    )
+    fix_hint = (
+        "compile ahead of time: submit jit(fn).lower(abstract_args).compile() "
+        "jobs to the AOT compile service (runtime/compiler.py) instead of "
+        "executing dummy steps — no execution, no device_put traffic, "
+        "concurrent backend compiles off the timed path"
+    )
+
+    # Warm/init scopes: the execute-to-compile pattern (dispatch a dummy
+    # step + block on it, discard the result) is only a finding THERE — in a
+    # hot training loop a dispatch+sync is just training.
+    _WARM_NAMES = {"__init__", "__post_init__", "setup"}
+    _WARM_MARKERS = ("warm",)
+    # Scopes allowed to call .compile() under a timer: the compile service
+    # itself (its job is measuring compile walls).
+    _COMPILE_SCOPE_PREFIXES = ("compile", "_compile", "aot", "_aot")
+
+    def _is_warm_scope(self, fn: Optional[ast.AST]) -> bool:
+        if fn is None or isinstance(fn, ast.Lambda):
+            return False
+        name = fn.name
+        return name in self._WARM_NAMES or any(
+            m in name.lower() for m in self._WARM_MARKERS
+        )
+
+    # ---- pattern A: dispatch + sync inside a loop in a warm scope
+
+    def _check_warm_loops(self, ctx, jit_bound) -> Iterator["Finding"]:
+        seen_loops: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _is_dispatch_call(node, jit_bound)):
+                continue
+            fn = _innermost_function(node, ctx.parents)
+            if not self._is_warm_scope(fn):
+                continue
+            loop = enclosing_loop(node, ctx.parents, stop_at=fn)
+            if loop is None or id(loop) in seen_loops:
+                continue
+            loop_calls = [
+                c
+                for c in ast.walk(loop)
+                if isinstance(c, ast.Call)
+                and _innermost_function(c, ctx.parents) is fn
+            ]
+            if not any(_is_sync_call(c) for c in loop_calls):
+                continue
+            seen_loops.add(id(loop))
+            first = min(
+                (c for c in loop_calls if _is_dispatch_call(c, jit_bound)),
+                key=lambda c: (c.lineno, c.col_offset),
+            )
+            yield _finding(
+                self.code,
+                ctx,
+                first,
+                f"warm scope `{fn.name}` compiles by EXECUTING "
+                f"`{call_name(first) or '<jit>'}` in a loop (dispatch + sync, "
+                "result discarded): a serial execute-to-compile warm wall",
+                self.fix_hint,
+            )
+
+    # ---- pattern B: lowered.compile() inside a wall-clock window
+
+    @staticmethod
+    def _lowered_names(fn: ast.AST, ctx) -> Set[str]:
+        """Local names bound from a ``*.lower(...)`` call."""
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _attr_tail(call_name(node.value)) == "lower"
+            ):
+                out |= assign_targets(node)
+        return out
+
+    def _is_blocking_compile(self, node: ast.Call, lowered: Set[str]) -> bool:
+        if not (
+            isinstance(node.func, ast.Attribute) and node.func.attr == "compile"
+        ):
+            return False
+        recv = node.func.value
+        if isinstance(recv, ast.Call) and _attr_tail(call_name(recv)) == "lower":
+            return True  # fn.lower(...).compile()
+        return isinstance(recv, ast.Name) and recv.id in lowered
+
+    def _check_timed_compiles(self, ctx) -> Iterator["Finding"]:
+        window_rule = RULES_G002_WINDOWS
+        for fn in [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            if fn.name.startswith(self._COMPILE_SCOPE_PREFIXES):
+                continue
+            windows = window_rule._windows(fn, ctx)
+            if not windows:
+                continue
+            lowered = self._lowered_names(fn, ctx)
+            calls = _function_calls(fn, ctx.parents)
+            for var, s_line, e_line in windows:
+                for c in calls:
+                    if s_line < c.lineno <= e_line and self._is_blocking_compile(
+                        c, lowered
+                    ):
+                        yield _finding(
+                            self.code,
+                            ctx,
+                            c,
+                            f"blocking XLA `.compile()` inside timed window "
+                            f"`{var}` (lines {s_line}-{e_line}) — the wall "
+                            "measures the compiler, not the program; compile "
+                            "ahead of time and fetch the executable",
+                            self.fix_hint,
+                        )
+                        break
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        jit_bound = _jit_bound_names(ctx.tree)
+        yield from self._check_warm_loops(ctx, jit_bound)
+        yield from self._check_timed_compiles(ctx)
+
+
+# G007 reuses G002's timed-window extraction; share one instance.
+RULES_G002_WINDOWS = RuleG002()
+
 RULES: Dict[str, object] = {
     r.code: r
     for r in (
         RuleG001(),
-        RuleG002(),
+        RULES_G002_WINDOWS,
         RuleG003(),
         RuleG004(),
         RuleG005(),
         RuleG006(),
+        RuleG007(),
     )
 }

@@ -220,6 +220,30 @@ class Config:
                                        # inside a timed epoch (benchmarks set
                                        # this; the persistent compile cache
                                        # makes it cheap on reruns)
+    aot_warm: bool = True              # run the compile universe through the
+                                       # async AOT compile service
+                                       # (runtime/compiler.py): executables
+                                       # are jit(...).lower(abstract).
+                                       # compile()d concurrently on a thread
+                                       # pool — no dummy execution, no
+                                       # device_put traffic — and hot
+                                       # dispatch resolves the compiled
+                                       # objects from the service. off = the
+                                       # legacy execute-to-compile warm loop
+                                       # (kept as the A/B reference; see
+                                       # bench aot_warm_ab + graftlint G007)
+    aot_pool: int = 0                  # AOT compile pool width; 0 = auto
+                                       # (min(8, cpus), >= 2). Lowering is
+                                       # single-flight (GIL-bound) either
+                                       # way; the pool parallelizes the
+                                       # backend-compile phase
+    aot_speculate: bool = True         # when a rebalance dispatches a
+                                       # ladder rung, background-compile the
+                                       # ADJACENT rungs (±bucket) while the
+                                       # epoch executes, so the next
+                                       # rebalance's fresh layout is already
+                                       # compiled and the recompile sentinel
+                                       # stays silent (dbs runs only)
     device_cache: str = "auto"         # "auto"|"on"|"off": keep the train
                                        # arrays resident in HBM and feed each
                                        # epoch by INDEX (on-device gather in
@@ -315,6 +339,8 @@ class Config:
             raise ValueError("superstep must be 'auto', 'on' or 'off'")
         if self.superstep_window < 1:
             raise ValueError("superstep_window must be >= 1")
+        if self.aot_pool < 0:
+            raise ValueError("aot_pool must be >= 0 (0 = auto)")
         if self.compress_grads and self.dynamic_batch_size and not self.fused_dbs:
             raise ValueError(
                 "compress_grads rides a fused path (the elastic DBS combine "
@@ -460,6 +486,16 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_pallas", type=str2bool, default=d.use_pallas)
     p.add_argument("--use_flash_attention", type=str2bool, default=d.use_flash_attention)
     p.add_argument("--warm_start", type=str2bool, default=d.warm_start)
+    p.add_argument("--aot_warm", type=str2bool, default=d.aot_warm,
+                   help="Warm + dispatch through the async AOT compile "
+                        "service (lower(abstract).compile() on a thread "
+                        "pool; zero execute-to-compile). off = legacy "
+                        "execute-to-compile warm loop.")
+    p.add_argument("--aot_pool", type=int, default=d.aot_pool,
+                   help="AOT compile pool width (0 = auto).")
+    p.add_argument("--aot_speculate", type=str2bool, default=d.aot_speculate,
+                   help="Background-compile adjacent ladder rungs during "
+                        "epochs so mid-run rebalances never block on XLA.")
     p.add_argument("--device_cache", type=str, default=d.device_cache,
                    choices=["auto", "on", "off"],
                    help="Keep train arrays HBM-resident and feed epochs by "

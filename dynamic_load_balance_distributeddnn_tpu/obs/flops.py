@@ -42,11 +42,19 @@ def chip_peak_flops(device=None) -> Optional[float]:
     return None
 
 
-def compiled_flops(jitted_fn, *args) -> Optional[float]:
+def compiled_flops(jitted_fn, *args, compiled=None) -> Optional[float]:
     """FLOPs of one execution of ``jitted_fn(*args)`` per XLA's cost model.
-    Returns None when the backend doesn't expose cost analysis."""
+    Returns None when the backend doesn't expose cost analysis.
+
+    ``compiled``: an already-compiled executable (``jax.stages.Compiled``,
+    e.g. fetched from the AOT compile service) — its cost analysis is read
+    directly and NOTHING is recompiled. Without it this function lowers and
+    compiles a second copy of the step just to ask for its cost, which on a
+    big model is a whole duplicate XLA compile."""
     try:
-        cost = jitted_fn.lower(*args).compile().cost_analysis()
+        if compiled is None:
+            compiled = jitted_fn.lower(*args).compile()
+        cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):  # some backends wrap in a list
             cost = cost[0] if cost else {}
         val = float(cost.get("flops", 0.0))

@@ -1,5 +1,9 @@
-"""Native host runtime: C++ gather/pack + solver behind a ctypes bridge."""
+"""Host runtime: C++ gather/pack + solver bridge, watchdog, AOT compiler."""
 
+from dynamic_load_balance_distributeddnn_tpu.runtime.compiler import (
+    AOTCompileService,
+    default_pool_size,
+)
 from dynamic_load_balance_distributeddnn_tpu.runtime.native import (
     native_available,
     native_integer_batch_split,
@@ -8,6 +12,8 @@ from dynamic_load_balance_distributeddnn_tpu.runtime.native import (
 )
 
 __all__ = [
+    "AOTCompileService",
+    "default_pool_size",
     "native_available",
     "native_integer_batch_split",
     "native_rebalance",

@@ -1,0 +1,349 @@
+"""Async AOT compile service: the warm path off the critical path.
+
+The engine's old warm-start compiled its bucket-ladder executables by
+*executing dummy steps* — allocate a zero batch, ``device_put`` five arrays,
+dispatch, ``block_until_ready`` — serially, one rung at a time
+(engine._warm_shapes, kept behind ``--aot_warm off`` as the A/B reference and
+flagged by graftlint G007). On short benchmark runs that warm wall dominated;
+two bench rounds died inside it (BENCH_r04/r05, rc=124).
+
+This service compiles the same executables ahead of time:
+
+* ``jit(fn).lower(abstract_args).compile()`` — **no dummy execution, no
+  host→device traffic**. Data arguments are :class:`jax.ShapeDtypeStruct`
+  specs (shape + dtype + committed sharding); parameter/state trees are
+  passed as the live arrays (zero-copy — ``lower`` only reads avals, and a
+  concrete leaf carries its exact weak-type/committed-ness, which a spec
+  cannot express).
+* compile jobs run **concurrently** on a small thread pool — XLA releases
+  the GIL during backend compile — with a **single-flight lowering lock**:
+  tracing/lowering is GIL-bound Python, so at most one job traces while the
+  others sit in backend compile. The pool becomes a software pipeline
+  (trace job k+1 under job k's compile) instead of a GIL convoy.
+* jobs are **deduped by key**: submitting an already-submitted key returns
+  the existing future, so N workers sharing a device (or a warm pass racing
+  a speculative compile) never trigger N backend compiles of one program.
+
+In jax 0.4.x an AOT ``Compiled`` does *not* populate the lazy ``jit``
+call cache, so the service is also the **executable registry**: the engine
+resolves its hot dispatch through :meth:`get` and calls the ``Compiled``
+object directly (same HLO, same donation semantics — bitwise-identical to
+the lazy path; dispatch overhead is within a few microseconds of the C++
+jit cache). A key the service doesn't hold falls back to the lazy wrapper.
+
+Compile events raised by pool threads carry the :data:`AOT_THREAD_PREFIX`
+thread name, which analysis/guards.py uses to keep background compiles out
+of the engine's recompile sentinel (they are deliberate, overlapped work,
+not a shape falling off the ladder) while still counting them in budgets
+opened with ``include_background=True``.
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import os
+import threading
+import time
+import weakref
+from typing import Callable, Deque, Dict, Hashable, List, Optional, Sequence, Tuple
+
+# Thread-name prefix for the compile pool — defined in analysis/guards.py
+# (the consumer that matches it to attribute backend-compile events to
+# background AOT work) and imported here so the two can never drift.
+from dynamic_load_balance_distributeddnn_tpu.analysis.guards import (
+    AOT_THREAD_PREFIX,
+)
+
+
+def default_pool_size() -> int:
+    """Pool width when the config leaves it at 0 (auto): enough to keep the
+    backend compiler busy without convoying tracing threads on the GIL."""
+    return max(2, min(8, os.cpu_count() or 2))
+
+
+# Live pools, drained at interpreter shutdown. The hook registers with
+# threading._register_atexit — the same internal mechanism
+# concurrent.futures uses — which runs BEFORE the interpreter joins
+# non-daemon threads, so it can still cancel queued jobs.
+_live_pools: "weakref.WeakSet[_CompilePool]" = weakref.WeakSet()
+_exit_hook_installed = False
+
+
+def _drain_pools_at_exit() -> None:
+    for pool in list(_live_pools):
+        pool.shutdown(drop_pending=True)
+
+
+def _install_exit_hook() -> None:
+    global _exit_hook_installed
+    if _exit_hook_installed:
+        return
+    _exit_hook_installed = True
+    try:
+        threading._register_atexit(_drain_pools_at_exit)  # 3.9+
+    except AttributeError:  # pragma: no cover - very old Python
+        import atexit
+
+        atexit.register(_drain_pools_at_exit)
+
+
+class _CompilePool:
+    """Minimal fixed-size worker pool tuned for XLA compile jobs.
+
+    Threads are NON-daemon: a thread killed mid-backend-compile at
+    interpreter exit segfaults or std::terminates inside XLA (measured), so
+    in-flight compiles must be allowed to finish. The exit hook above
+    cancels everything still QUEUED, so process exit waits for at most one
+    in-flight compile per worker instead of the whole backlog (the failure
+    mode ThreadPoolExecutor's exit join has: it drains the entire queue)."""
+
+    def __init__(self, workers: int, name_prefix: str):
+        self._cv = threading.Condition()
+        self._items: Deque = collections.deque()
+        self._stop = False
+        _install_exit_hook()
+        _live_pools.add(self)
+        self._threads = [
+            threading.Thread(
+                target=self._run, name=f"{name_prefix}-{i}", daemon=False
+            )
+            for i in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._items and not self._stop:
+                    self._cv.wait()
+                if self._items:
+                    fut, fn, args = self._items.popleft()
+                elif self._stop:
+                    return
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:  # noqa: BLE001 - delivered via the future
+                fut.set_exception(e)
+
+    def submit(self, fn, *args) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._cv:
+            if self._stop:
+                fut.cancel()
+                return fut
+            self._items.append((fut, fn, args))
+            self._cv.notify()
+        return fut
+
+    def shutdown(self, drop_pending: bool = False) -> None:
+        with self._cv:
+            self._stop = True
+            if drop_pending:
+                for fut, _fn, _args in self._items:
+                    fut.cancel()
+                self._items.clear()
+            self._cv.notify_all()
+
+
+class AOTCompileService:
+    """Concurrent ahead-of-time compiler + compiled-executable registry.
+
+    ``workers``: pool width (0 = :func:`default_pool_size`). The pool is
+    created lazily on the first ``submit`` — a service used only for
+    ``compile_now`` never spawns a thread.
+
+    ``tick``: optional callback invoked after every finished compile job
+    (the engine passes the watchdog heartbeat, so a long TPU compile ladder
+    keeps answering the stall watchdog the way the execute-to-compile warm
+    loop used to).
+    """
+
+    def __init__(
+        self,
+        workers: int = 0,
+        logger=None,
+        tick: Optional[Callable[[], None]] = None,
+    ):
+        self._workers = int(workers) or default_pool_size()
+        self._logger = logger
+        self._tick = tick
+        self._pool: Optional[_CompilePool] = None
+        self._lock = threading.Lock()
+        # Single-flight lowering: tracing is GIL-bound Python; serializing it
+        # across jobs turns the pool into a lower/compile pipeline instead of
+        # a GIL convoy (measured 2x on the 2-core CPU tier vs naive pooling).
+        self._lower_lock = threading.Lock()
+        self._jobs: Dict[Hashable, concurrent.futures.Future] = {}
+        self._done: Dict[Hashable, object] = {}  # key -> jax.stages.Compiled
+        self._stats = {
+            "submitted": 0,
+            "deduped": 0,
+            "compiled": 0,
+            "failed": 0,
+            "speculative": 0,
+            "compile_wall_s": 0.0,
+        }
+
+    # ------------------------------------------------------------- internals
+
+    def _ensure_pool_locked(self) -> _CompilePool:
+        if self._pool is None:
+            self._pool = _CompilePool(self._workers, AOT_THREAD_PREFIX)
+        return self._pool
+
+    def _compile_job(self, key: Hashable, fn, args: Sequence):
+        t0 = time.perf_counter()
+        try:
+            with self._lower_lock:
+                lowered = fn.lower(*args)
+            compiled = lowered.compile()
+        except BaseException:
+            with self._lock:
+                self._stats["failed"] += 1
+            raise
+        finally:
+            if self._tick is not None:
+                try:
+                    self._tick()
+                except Exception:  # pragma: no cover - heartbeat must not kill jobs
+                    pass
+        with self._lock:
+            self._done[key] = compiled
+            self._stats["compiled"] += 1
+            self._stats["compile_wall_s"] += time.perf_counter() - t0
+        return compiled
+
+    # ------------------------------------------------------------ public API
+
+    def submit(
+        self, key: Hashable, fn, args: Sequence, speculative: bool = False
+    ) -> concurrent.futures.Future:
+        """Queue one AOT compile; dedup by ``key``.
+
+        ``fn`` is a jitted callable, ``args`` its lowering arguments
+        (ShapeDtypeStruct specs and/or live arrays). Returns the job's
+        future; a key submitted before (in flight, done, or failed) returns
+        the existing future without queueing anything.
+        """
+        with self._lock:
+            fut = self._jobs.get(key)
+            if fut is not None:
+                self._stats["deduped"] += 1
+                return fut
+            pool = self._ensure_pool_locked()
+            self._stats["submitted"] += 1
+            if speculative:
+                self._stats["speculative"] += 1
+            fut = pool.submit(self._compile_job, key, fn, args)
+            self._jobs[key] = fut
+            return fut
+
+    def compile_now(self, key: Hashable, fn, args: Sequence):
+        """Blocking compile with the same dedup table as :meth:`submit`.
+
+        A fresh key compiles INLINE on the caller thread (no pool, no queue
+        delay — this is the path for one-off executables like the fused
+        sync/FLOPs probes); a key already in flight joins that job instead.
+        """
+        with self._lock:
+            fut = self._jobs.get(key)
+            if fut is None:
+                fut = concurrent.futures.Future()
+                self._jobs[key] = fut
+                self._stats["submitted"] += 1
+                inline = True
+            else:
+                self._stats["deduped"] += 1
+                inline = False
+        if not inline:
+            return fut.result()
+        # Borrow the AOT thread-name prefix for the inline job so guards
+        # attributes its backend-compile events as deliberate AOT work —
+        # same classification as pool jobs (one compile must not read as a
+        # foreground recompile to the sentinel just because it ran inline).
+        me = threading.current_thread()
+        saved = me.name
+        me.name = AOT_THREAD_PREFIX + "-inline"
+        try:
+            compiled = self._compile_job(key, fn, args)
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            me.name = saved
+        fut.set_result(compiled)
+        return compiled
+
+    def has(self, key: Hashable) -> bool:
+        """Key known (queued, compiling, done, or failed)?"""
+        with self._lock:
+            return key in self._jobs
+
+    def get(self, key: Hashable):
+        """Finished ``Compiled`` for ``key``, or None (absent / in flight /
+        failed). Non-blocking — the dispatch-time resolution path."""
+        return self._done.get(key)
+
+    def wait(
+        self,
+        keys: Optional[Sequence[Hashable]] = None,
+        timeout: Optional[float] = None,
+    ) -> List[Tuple[Hashable, BaseException]]:
+        """Barrier: block until the given keys (default: every submitted job)
+        finish. Returns ``(key, exception)`` pairs for failed jobs — the
+        caller logs them and falls back to lazy dispatch; the failed key
+        stays in the dedup table so it is not endlessly retried."""
+        with self._lock:
+            if keys is None:
+                pending = list(self._jobs.items())
+            else:
+                pending = [(k, self._jobs[k]) for k in keys if k in self._jobs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        failures: List[Tuple[Hashable, BaseException]] = []
+        for key, fut in pending:
+            left = None if deadline is None else max(deadline - time.monotonic(), 0.0)
+            try:
+                fut.result(timeout=left)
+            except concurrent.futures.TimeoutError:
+                raise
+            except BaseException as e:
+                failures.append((key, e))
+        return failures
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(1 for f in self._jobs.values() if not f.done())
+
+    def keys(self) -> List[Hashable]:
+        with self._lock:
+            return list(self._jobs)
+
+    def count_keys(self, name_prefixes: Tuple[str, ...]) -> int:
+        """Compiled executables whose key[0] starts with one of the given
+        names — e.g. the superstep variants for the engine's compile-once
+        cross-check."""
+        with self._lock:
+            return sum(
+                1
+                for k in self._done
+                if isinstance(k, tuple)
+                and k
+                and isinstance(k[0], str)
+                and k[0].startswith(name_prefixes)
+            )
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._stats)
+
+    def close(self, wait: bool = True) -> None:
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(drop_pending=not wait)
+            if wait:
+                self.wait()

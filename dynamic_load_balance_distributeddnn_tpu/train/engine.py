@@ -62,6 +62,7 @@ from dynamic_load_balance_distributeddnn_tpu.ops.faultload import calibrate_iter
 from dynamic_load_balance_distributeddnn_tpu.ops.losses import example_weights
 from dynamic_load_balance_distributeddnn_tpu.parallel import WorkerTopology, data_mesh
 from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import replicated_sharding
+from dynamic_load_balance_distributeddnn_tpu.runtime.compiler import AOTCompileService
 from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import heartbeat
 from dynamic_load_balance_distributeddnn_tpu.train.schedule import one_cycle_lr
 from dynamic_load_balance_distributeddnn_tpu.train.state import create_state, make_optimizer
@@ -162,6 +163,30 @@ class Trainer:
 
         self._setup_data(bundle)
         self._setup_model()
+
+        # Async AOT compile service (runtime/compiler.py): warm-start and
+        # speculative compiles run as jit(...).lower(abstract).compile() jobs
+        # on a thread pool — no dummy execution, no device_put traffic — and
+        # the elastic hot loop dispatches the compiled executables directly
+        # (the lazy jit wrappers stay as fallback). aot_warm=False keeps the
+        # legacy execute-to-compile warm loop as the A/B reference.
+        self._aot: Optional[AOTCompileService] = None
+        if cfg.aot_warm:
+            self._aot = AOTCompileService(
+                workers=cfg.aot_pool, logger=self.logger, tick=heartbeat
+            )
+            self.steps.aot_service = self._aot
+            # tie the pool's lifetime to the trainer: processes that build
+            # many engines (the test tier, bench retry/insurance loops) must
+            # not accumulate idle non-daemon compile threads
+            import weakref
+
+            weakref.finalize(self, self._aot.close, False)
+        self._aot_view_specs: Dict[int, object] = {}
+        self._aot_dummy_template: list = []
+        self._aot_failed_logged: set = set()
+        self._aot_warm_t0: Optional[float] = None
+        self._aot_compiled_last = 0.0
 
         if injector is not None:
             self.injector = injector
@@ -405,17 +430,327 @@ class Trainer:
             np.full((b,), 1.0 / max(b * self.cfg.world_size, 1), dtype=np.float32),
         )
 
-    def _warm_shapes(self) -> None:
-        """Pre-compile the elastic step for every padded batch shape the
-        balancer can produce (multiples of ``bucket`` up to the capacity cap),
-        on every used device. Without this, each rebalance's fresh shape pays
-        its XLA compile inside a timed epoch — on short benchmark runs the
-        compiles dominate and bury the balancer's actual win. One-time cost,
-        amortized further by the persistent compilation cache."""
+    # ------------------------------------------------- AOT compile service
+    # (runtime/compiler.py). The compile universe — per-step ladder rungs,
+    # windowed twins, superstep scan keys — is described as abstract
+    # ShapeDtypeStruct args (committed single-device shardings; param/state
+    # trees ride in as live arrays so weak types and committed-ness are
+    # exact) and compiled concurrently in the background. Dispatch resolves
+    # the compiled executables from the service by (kind, batch, window,
+    # device) key and falls back to the lazy jit wrappers on a miss.
+
+    def _warm_ladder(self) -> "tuple[list, int]":
+        """(ladder rungs, capacity width): every padded batch shape the
+        balancer can produce — bucket multiples up to ``_cap_b``. Single
+        source of truth for both warm paths (AOT and legacy)."""
+        max_b = self._cap_b
+        return list(range(self.cfg.bucket, max_b + 1, self.cfg.bucket)), max_b
+
+    def _dummy_arg_shapes(self, b: int) -> list:
+        """Per-(x, y, w) ``(shape, dtype)`` at batch ``b`` WITHOUT
+        materializing batches: ``_dummy_batch``'s leading dim is the batch
+        by contract (vision and LM alike), so one b=1 template — built once
+        — scales to every rung. Spec building on the real TPU ladder would
+        otherwise allocate and discard tens of MB of zeros per sweep."""
+        if not self._aot_dummy_template:
+            self._aot_dummy_template = [
+                (tuple(t.shape[1:]), t.dtype) for t in self._dummy_batch(1)
+            ]
+        return [((b,) + s, dt) for s, dt in self._aot_dummy_template]
+
+    def _aot_sds(self, shape, dtype, dev):
+        from jax.sharding import SingleDeviceSharding
+
+        return jax.ShapeDtypeStruct(
+            tuple(int(s) for s in shape), dtype, sharding=SingleDeviceSharding(dev)
+        )
+
+    @staticmethod
+    def _aot_step_key(kind: str, b: int, d: int, win: Optional[int]) -> tuple:
+        return (kind, int(b), int(win or 0), int(d))
+
+    def _aot_view_spec(self, d: int):
+        """Abstract spec of device d's params view: shapes/dtypes/shardings
+        never change across steps, so one spec serves the whole run (and
+        holds no reference to any live param buffers)."""
+        if d not in self._aot_view_specs:
+            views = shard_views(self.state.params, self.topology.devices)
+            self._aot_view_specs[d] = jax.tree_util.tree_map(
+                lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=t.sharding),
+                views[d],
+            )
+        return self._aot_view_specs[d]
+
+    def _aot_resolve(self, kind: str, b: int, d: int, win: Optional[int], fallback):
+        """Compiled executable for a dispatch site, or the lazy jit
+        fallback. Non-blocking: an in-flight or failed job falls back."""
+        if self._aot is None:
+            return fallback
+        return self._aot.get(self._aot_step_key(kind, b, d, win)) or fallback
+
+    def _aot_submit_worker_steps(
+        self, d: int, b: int, wins, want_acc: bool, want_plain: bool,
+        speculative: bool = False,
+    ) -> list:
+        """Queue the worker-step executables for one (device, rung): the
+        plain single-step pair (probes + step-mode dispatch) and the
+        window-sliced pair per window length (window-mode dispatch). Returns
+        the submitted/deduped keys. ``_dummy_batch`` output is used purely
+        as a host-side shape/dtype template — nothing is transferred."""
+        svc = self._aot
+        if svc is None:
+            return []
+        use_cache = self._use_device_cache
+        suffix = "_idx" if use_cache else ""
+        kinds = []
+        if want_plain:
+            kinds.append(("worker_first" + suffix, None))
+            if want_acc:
+                kinds.append(("worker_acc" + suffix, None))
+        for win in wins or ():
+            kinds.append(("worker_first_win" + suffix, win))
+            if want_acc:
+                kinds.append(("worker_acc_win" + suffix, win))
+        keys = [self._aot_step_key(kind, b, d, win) for kind, win in kinds]
+        if all(svc.has(k) for k in keys):
+            return keys  # steady state: skip all spec construction
+        dev = self.topology.devices[d]
+        sds = lambda shape, dt: self._aot_sds(shape, dt, dev)  # noqa: E731
+        view = self._aot_view_spec(d)
+        (xs_, xd), (ys_, yd), (ws_sh, wd) = self._dummy_arg_shapes(b)
+        key_t = sds((2,), jnp.uint32)
+        slow_t = sds((), jnp.int32)
+        acc_t = jax.tree_util.tree_map(
+            lambda p: self._aot_sds((1,) + tuple(p.shape), p.dtype, dev), view
+        )
+        cache = self._device_cache_for(d) if use_cache else ()
+        targets = []
+        if want_plain:
+            if use_cache:
+                data = cache + (sds((b,), jnp.int32), sds(ws_sh, wd))
+            else:
+                data = (sds(xs_, xd), sds(ys_, yd), sds(ws_sh, wd))
+            targets.append(("worker_first" + suffix, (view,) + data + (key_t, slow_t), None))
+            if want_acc:
+                targets.append(
+                    ("worker_acc" + suffix, (view, acc_t) + data + (key_t, slow_t), None)
+                )
+        for win in wins or ():
+            kw_t = sds((win, 2), jnp.uint32)
+            s_t = sds((), jnp.int32)
+            if use_cache:
+                data = cache + (sds((win, b), jnp.int32), sds((win,) + ws_sh, wd))
+            else:
+                data = (
+                    sds((win,) + xs_, xd),
+                    sds((win,) + ys_, yd),
+                    sds((win,) + ws_sh, wd),
+                )
+            targets.append(
+                ("worker_first_win" + suffix, (view,) + data + (kw_t, s_t, slow_t), win)
+            )
+            if want_acc:
+                targets.append(
+                    ("worker_acc_win" + suffix, (view, acc_t) + data + (kw_t, s_t, slow_t), win)
+                )
+        lows = self.steps.aot_lowerables()
+        keys = []
+        for kind, args, win in targets:
+            k = self._aot_step_key(kind, b, d, win)
+            if not svc.has(k):
+                svc.submit(k, lows[kind], args, speculative=speculative)
+            keys.append(k)
+        return keys
+
+    def _aot_submit_superstep(self, padded, win: int, speculative: bool = False) -> list:
+        """Queue one scan-mode superstep (shape-tuple, window) key. The
+        TrainState rides into lowering as the live tree (exact leaf
+        shardings/weak types — a spec cannot express committed-ness), which
+        is also why no zeros dummy state is needed anymore."""
+        svc = self._aot
+        if svc is None:
+            return []
+        topo = self.topology
+        d0 = topo.used_device_indices[0]
+        dev = topo.devices[d0]
+        use_cache = self._use_device_cache
+        name = "group_superstep_idx" if use_cache else "group_superstep"
+        shape_key = topo.group_shape_key(list(padded), win)
+        # register the key for the compile-once sentinel cross-check exactly
+        # like the legacy warm did
+        self._superstep_keys.add(shape_key)
+        k = (name, shape_key, d0)
+        if svc.has(k):
+            return [k]
+        sds = lambda shape, dt: self._aot_sds(shape, dt, dev)  # noqa: E731
+        cols = []
+        for b in padded:
+            (xs_, xd), (ys_, yd), (ws_sh, wd) = self._dummy_arg_shapes(b)
+            kw_t = sds((win, 2), jnp.uint32)
+            ww_t = sds((win,) + ws_sh, wd)
+            if use_cache:
+                cols.append((sds((win, b), jnp.int32), ww_t, kw_t))
+            else:
+                cols.append(
+                    (sds((win,) + xs_, xd), sds((win,) + ys_, yd), ww_t, kw_t)
+                )
+        tup = tuple(zip(*cols))
+        slows = tuple(sds((), jnp.int32) for _ in padded)
+        if use_cache:
+            args = (self.state,) + self._device_cache_for(d0) + tup + (slows,)
+        else:
+            args = (self.state,) + tup + (slows,)
+        svc.submit(k, self.steps.aot_lowerables()[name], args, speculative=speculative)
+        return [k]
+
+    def _submit_warm_aot(self) -> None:
+        """AOT warm-start: submit the whole compile universe and return
+        immediately — the pool compiles while the engine builds epoch 0's
+        plan (rebalance, partitioning, fault setup, probe scheduling); the
+        remaining jobs drain at run_epoch's pre-wall barrier so no TIMED
+        region ever shares cores with the compiler."""
         cfg = self.cfg
-        max_share = min(1.0, cfg.capacity_factor / cfg.world_size)
-        max_b = -(-int(np.ceil(max_share * cfg.batch_size)) // cfg.bucket) * cfg.bucket
-        ladder = list(range(cfg.bucket, max_b + 1, cfg.bucket))
+        self._aot_warm_t0 = time.perf_counter()
+        ladder, max_b = self._warm_ladder()
+        warm_acc = any(len(g) > 1 for g in self.topology.groups.values())
+        mode = self._elastic_mode()
+        wins: tuple = ()
+        plan0 = None
+        if mode in ("window", "scan"):
+            plan0 = self._build_plan(0, integer_batch_split(self.shares, cfg.batch_size))
+            wins = tuple(
+                sorted({s1 - s0 for s0, s1 in self._elastic_ranges(plan0.num_steps)})
+            )
+        n = 0
+        for d in self.topology.used_device_indices:
+            for b in ladder:
+                n += len(
+                    self._aot_submit_worker_steps(
+                        d, b, wins if mode == "window" else (), warm_acc, want_plain=True
+                    )
+                )
+        if mode == "scan":
+            d0 = self.topology.used_device_indices[0]
+            group = self.topology.groups[d0]
+            padded = [plan0.workers[self.rank_lo + r].padded_batch for r in group]
+            for win in wins:
+                n += len(self._aot_submit_superstep(padded, win))
+        self.logger.info(
+            f"AOT warm: submitted {n} compile jobs ({len(ladder)} ladder rungs "
+            f"up to {max_b}, windows {list(wins)}) — no dummy execution; "
+            "compiles overlap epoch-0 plan build, drained before its wall"
+        )
+
+    def _aot_stage_plan(self, plan) -> tuple:
+        """Submit this plan's missing executables (a mid-run rebalance on a
+        cold service compiles concurrently instead of serially-lazily) plus
+        speculative adjacent ladder rungs, and return the keys the epoch's
+        dispatch will barrier on."""
+        if self._aot is None:
+            return ()
+        cfg = self.cfg
+        mode = self._elastic_mode()
+        topo = self.topology
+        ranges = self._elastic_ranges(plan.num_steps)
+        wins = tuple(sorted({s1 - s0 for s0, s1 in ranges}))
+        needed: list = []
+        if mode == "scan":
+            d0 = topo.used_device_indices[0]
+            group = topo.groups[d0]
+            padded = [plan.workers[self.rank_lo + r].padded_batch for r in group]
+            for win in wins:
+                needed += self._aot_submit_superstep(padded, win)
+            # the standalone probes still run the plain single-step rungs
+            for r in group:
+                b = plan.workers[self.rank_lo + r].padded_batch
+                needed += self._aot_submit_worker_steps(
+                    d0, b, (), want_acc=False, want_plain=True
+                )
+        else:
+            for d in topo.used_device_indices:
+                group = topo.groups[d]
+                want_acc = len(group) > 1
+                for r in group:
+                    b = plan.workers[self.rank_lo + r].padded_batch
+                    needed += self._aot_submit_worker_steps(
+                        d, b, wins if mode == "window" else (), want_acc, want_plain=True
+                    )
+        return tuple(dict.fromkeys(needed))
+
+    def _maybe_speculate(self, plan) -> None:
+        """Background-compile the ladder rungs ADJACENT to this plan's
+        (±bucket, capacity-clamped): the next rebalance moves each worker at
+        most a few rungs, so its fresh layout is compiled before it is
+        dispatched and the recompile sentinel stays silent. Called from
+        run_epoch AFTER the timed region — the jobs overlap the untimed
+        validation tail (and drain at the next epoch's pre-wall barrier), so
+        timed walls never share cores with the compiler. Only meaningful on
+        the snapped ladder — unsnapped plans have no finite adjacency."""
+        cfg = self.cfg
+        if (
+            self._aot is None
+            or not cfg.aot_speculate
+            or not cfg.dynamic_batch_size
+            or self._elastic_mode() == "scan"  # shape TUPLES: no finite adjacency
+        ):
+            return
+        wins = ()
+        if self._elastic_mode() == "window":
+            wins = tuple(
+                sorted({s1 - s0 for s0, s1 in self._elastic_ranges(plan.num_steps)})
+            )
+        self._aot_speculate(plan, wins)
+
+    def _aot_speculate(self, plan, wins) -> None:
+        cfg = self.cfg
+        if not (cfg.snap_to_bucket and self.SNAP_BATCHES):
+            return
+        max_b = self._cap_b
+        for d in self.topology.used_device_indices:
+            group = self.topology.groups[d]
+            want_acc = len(group) > 1
+            for r in group:
+                b = plan.workers[self.rank_lo + r].padded_batch
+                for nb in (b - cfg.bucket, b + cfg.bucket):
+                    if cfg.bucket <= nb <= max_b:
+                        self._aot_submit_worker_steps(
+                            d, nb, wins, want_acc, want_plain=True, speculative=True
+                        )
+
+    def _aot_wait_needed(self, keys, epoch: int) -> None:
+        """Barrier on the keys this epoch dispatches. Failed jobs log once
+        and dispatch falls back to the lazy jit wrappers (``get`` returns
+        None for a failed key)."""
+        if self._aot is None or not keys:
+            return
+        t0 = time.perf_counter()
+        for k, e in self._aot.wait(keys):
+            if k not in self._aot_failed_logged:
+                self._aot_failed_logged.add(k)
+                self.logger.warning(
+                    f"AOT compile failed for {k}: {e!r} — falling back to lazy jit"
+                )
+        dt = time.perf_counter() - t0
+        if self._aot_warm_t0 is not None:
+            self.logger.info(
+                f"AOT warm: epoch-{epoch} dispatch barrier {dt:.2f}s "
+                f"({time.perf_counter() - self._aot_warm_t0:.1f}s since "
+                "submission; remaining jobs keep compiling in the background)"
+            )
+            self._aot_warm_t0 = None
+
+    def _warm_shapes(self) -> None:
+        """LEGACY execute-to-compile warm (``--aot_warm off``): pre-compile
+        the elastic step for every padded batch shape the balancer can
+        produce (multiples of ``bucket`` up to the capacity cap), on every
+        used device, by executing dummy steps serially. Kept as the
+        serial-vs-concurrent A/B reference (bench aot_warm_ab) — the AOT
+        service above is the production path. Without any warm, each
+        rebalance's fresh shape pays its XLA compile inside a timed epoch —
+        on short benchmark runs the compiles dominate and bury the
+        balancer's actual win."""
+        cfg = self.cfg
+        ladder, max_b = self._warm_ladder()
         key = jax.random.PRNGKey(0)
         slow = jnp.int32(0)
         t0 = time.perf_counter()
@@ -447,7 +782,9 @@ class Trainer:
                     )
                     step_first = self.steps.worker_step_first
                     step_acc = self.steps.worker_step_acc
-                acc, aux = step_first(views[d], *args)
+                # deliberate execute-to-compile: this IS the serial A/B
+                # reference leg (aot_warm off)
+                acc, aux = step_first(views[d], *args)  # graftlint: disable=G007
                 if warm_acc:
                     acc, aux = step_acc(views[d], acc, *args)
                 jax.block_until_ready(aux)
@@ -508,7 +845,8 @@ class Trainer:
                         )
                         step_first = self.steps.worker_step_first_win
                         step_acc = self.steps.worker_step_acc_win
-                    acc, aux = step_first(views[d], *args)
+                    # deliberate execute-to-compile (serial A/B reference leg)
+                    acc, aux = step_first(views[d], *args)  # graftlint: disable=G007
                     if warm_acc:
                         acc, aux = step_acc(views[d], acc, *args)
                     jax.block_until_ready(aux)
@@ -576,12 +914,14 @@ class Trainer:
             dummy = jax.tree_util.tree_map(zero_like, self.state)
             if use_cache:
                 idxs, ws_, ks = tup
-                _, aux = self.steps.group_superstep_idx(
+                # deliberate execute-to-compile (serial A/B reference leg)
+                _, aux = self.steps.group_superstep_idx(  # graftlint: disable=G007
                     dummy, *self._device_cache_for(d0), idxs, ws_, ks, slows
                 )
             else:
                 xs, ys, ws_, ks = tup
-                _, aux = self.steps.group_superstep(
+                # deliberate execute-to-compile (serial A/B reference leg)
+                _, aux = self.steps.group_superstep(  # graftlint: disable=G007
                     dummy, xs, ys, ws_, ks, slows
                 )
             jax.block_until_ready(aux)
@@ -664,7 +1004,10 @@ class Trainer:
     def _maybe_warm(self) -> None:
         if self.cfg.warm_start and not self._warmed:
             self._warmed = True
-            self._warm_shapes()
+            if self._aot is not None:
+                self._submit_warm_aot()  # non-blocking; compiles overlap epoch 0
+            else:
+                self._warm_shapes()
 
     def run_epoch(self, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
@@ -715,6 +1058,20 @@ class Trainer:
         faults = self.injector.epoch_faults(epoch, plan.num_steps, ctx)
         self._probe_this_epoch = self._should_probe(epoch, plan, faults)
 
+        # Drain pending AOT jobs (the warm universe's tail, the previous
+        # epoch's speculation) BEFORE the timed region: concurrent backend
+        # compiles contend with the epoch's own compute on CPU-bound hosts
+        # and would contaminate the A/B walls — the round-6 CPU insurance
+        # arm measured the dbs-on arm 2.4x WORSE purely from this
+        # contention. The drain wall lives exactly where the legacy warm
+        # wall lived (outside every epoch wall); in steady state nothing is
+        # pending and this is a no-op. The warm still overlaps everything
+        # up to here — plan build, rebalance, fault setup — and speculative
+        # jobs still overlap the epoch that submits them.
+        if self._aot is not None and self._aot.pending():
+            self._aot_wait_needed(tuple(self._aot.keys()), epoch)
+
+        ran_elastic = False
         t_epoch = time.perf_counter()
         if (
             cfg.shard_update or cfg.grad_accum > 1 or cfg.compress_grads
@@ -744,6 +1101,7 @@ class Trainer:
             )
         else:
             train_metrics = self._train_epoch_elastic(plan, faults, epoch)
+            ran_elastic = True
         # The wall excludes probe/instrumentation cost on EVERY path: the
         # fused path already kept its probes out (probe_overhead); the
         # elastic path's standalone worker probes (dbs_probe_cost) were
@@ -760,6 +1118,11 @@ class Trainer:
         epoch_wall = time.perf_counter() - t_epoch - probe_s
         self.total_wallclock += epoch_wall
         self.total_probe_s += probe_s
+
+        # speculative adjacent-rung compiles ride the UNTIMED tail: they
+        # overlap validation below and drain before the next timed region
+        if ran_elastic:
+            self._maybe_speculate(plan)
 
         val_loss, accuracy = self.validate()
 
@@ -810,6 +1173,13 @@ class Trainer:
         for k in ("host_dispatch_s", "host_put_s", "host_overhead_per_step_s"):
             if k in train_metrics:
                 extras[k] = train_metrics[k]
+        # AOT compile service: compile jobs finished during this epoch
+        # (background pool + inline compile_now). Deliberate overlapped work
+        # — kept OUT of the xla_compiles sentinel series below, visible here.
+        if self._aot is not None:
+            st = self._aot.stats()
+            extras["aot_compiles"] = float(st["compiled"]) - self._aot_compiled_last
+            self._aot_compiled_last = float(st["compiled"])
         # Corrected-injection reporting (compute-mode A/B hygiene): alongside
         # the NOMINAL straggler profile (meta straggler_factors), stamp the
         # REALIZED injected:clean device-compute profile derived from the
@@ -1344,10 +1714,19 @@ class Trainer:
                     compiled_flops,
                 )
 
+                # the sync probe above already compiled this exact program
+                # through the AOT service — reuse its executable for the
+                # cost analysis instead of compiling a second copy
+                pre = None
+                if self._aot is not None:
+                    pre = self._aot.get(
+                        ("fused_step_probe",) + tuple(int(s) for s in xs[0].shape)
+                    )
                 f = compiled_flops(
                     self.steps.fused_step_probe,
                     self.state, xs[0], ys[0], ws_[0], slow,
                     jnp.int32(cfg.seed * 31 + epoch),
+                    compiled=pre,
                 )
                 # cost_analysis reports the PER-DEVICE partitioned module's
                 # FLOPs (it processes global_batch / n_dev examples), so
@@ -1405,6 +1784,23 @@ class Trainer:
             ),
         }
 
+    def _aot_fused_probe(self, name: str, fn, args, sig: tuple):
+        """Resolve a fused-path probe executable through the AOT service's
+        blocking ``compile_now`` (inline, deduped): the SAME compiled object
+        then serves both the sync-probe timing and ``cost_analysis`` — no
+        second copy of the step is ever compiled for FLOPs accounting.
+        Single-host only (multi-host AOT lowering of the mesh program is
+        untested armor we don't need: those runs keep the lazy path)."""
+        if self._aot is None or self.n_proc > 1:
+            return fn
+        try:
+            return self._aot.compile_now((name,) + sig, fn, args)
+        except Exception as e:
+            self.logger.warning(
+                f"AOT compile_now({name}) failed: {e!r} — using lazy jit"
+            )
+            return fn
+
     def _probe_fused_sync(self, xs, ys, ws_, slow, seed, reps: int = 3) -> float:
         """Per-step collective cost on the fused path: time a full single
         step vs its comm-free twin (identical math, psums stripped) after
@@ -1413,9 +1809,10 @@ class Trainer:
         reference's compute/comm split contract (dbs.py:250, 297-299) on the
         path where comm is fused into the XLA program."""
         x0, y0, w0 = xs[0], ys[0], ws_[0]
+        sig = tuple(int(s) for s in x0.shape)
 
         def timed(fn, *args) -> float:
-            jax.block_until_ready(fn(*args))  # warm (compile + execute)
+            jax.block_until_ready(fn(*args))  # warm execute (pre-compiled)
             best = float("inf")
             for _ in range(reps):
                 t0 = time.perf_counter()
@@ -1424,14 +1821,22 @@ class Trainer:
             heartbeat()
             return best
 
-        t_full = timed(self.steps.fused_step_probe, self.state, x0, y0, w0, slow, seed)
-        t_local = timed(self.steps.fused_step_nocomm, self.state, x0, y0, w0, slow, seed)
+        full_args = (self.state, x0, y0, w0, slow, seed)
+        f_full = self._aot_fused_probe(
+            "fused_step_probe", self.steps.fused_step_probe, full_args, sig
+        )
+        f_local = self._aot_fused_probe(
+            "fused_step_nocomm", self.steps.fused_step_nocomm, full_args, sig
+        )
+        t_full = timed(f_full, *full_args)
+        t_local = timed(f_local, *full_args)
         # The standalone-psum fallback must run UNCONDITIONALLY: gating it on
         # the locally-measured delta would make processes execute different
         # collective programs in multi-host runs (timer noise differs per
         # host) and deadlock the mesh.
         zeros = jax.tree_util.tree_map(jnp.zeros_like, self.state.params)
-        t_psum = timed(self.steps.comm_probe, zeros)
+        f_psum = self._aot_fused_probe("comm_probe", self.steps.comm_probe, (zeros,), ())
+        t_psum = timed(f_psum, zeros)
         delta = t_full - t_local
         return float(delta) if delta > 0.0 else float(t_psum)
 
@@ -1519,17 +1924,22 @@ class Trainer:
         cols = tuple(zip(*(staged_d[r] for r in group)))
         slows = tuple(slow_dev[r] for r in group)
         self._superstep_keys.add(win_key)
+        use_cache = self._use_device_cache
+        name = "group_superstep_idx" if use_cache else "group_superstep"
+        fn = None
+        if self._aot is not None:
+            fn = self._aot.get((name, win_key, d))
+        if fn is None:
+            fn = self.steps.group_superstep_idx if use_cache else self.steps.group_superstep
         with self._host_meter.dispatch():
-            if self._use_device_cache:
+            if use_cache:
                 idxs, ws_, ks = cols
-                self.state, aux = self.steps.group_superstep_idx(
+                self.state, aux = fn(
                     self.state, *self._device_cache_for(d), idxs, ws_, ks, slows
                 )
             else:
                 xs, ys, ws_, ks = cols
-                self.state, aux = self.steps.group_superstep(
-                    self.state, xs, ys, ws_, ks, slows
-                )
+                self.state, aux = fn(self.state, xs, ys, ws_, ks, slows)
         aux_windows.append(aux)
 
     def _dispatch_combine_steps(
@@ -1551,6 +1961,21 @@ class Trainer:
         else:
             step_first = steps.worker_step_first_idx if use_cache else steps.worker_step_first
             step_acc = steps.worker_step_acc_idx if use_cache else steps.worker_step_acc
+        # Resolve each worker's executables once per window: service-compiled
+        # (AOT) when present, the lazy jit wrapper otherwise. Shapes come
+        # from the staged arrays themselves so the key can never drift from
+        # what is actually dispatched.
+        suffix = ("_win" if windowed else "") + ("_idx" if use_cache else "")
+        resolved = {}
+        for d in topo.used_device_indices:
+            for r in topo.groups[d]:
+                arrs = staged[d][r]
+                b = int(arrs[0].shape[1])
+                wl = int(arrs[0].shape[0]) if windowed else None
+                resolved[r] = (
+                    self._aot_resolve("worker_first" + suffix, b, d, wl, step_first),
+                    self._aot_resolve("worker_acc" + suffix, b, d, wl, step_acc),
+                )
         for s in range(win):
             s_i = np.int32(s)
             with self._host_meter.dispatch():
@@ -1567,10 +1992,11 @@ class Trainer:
                             args = cache + tuple(a[s] for a in arrs) + (
                                 slow_dev[r],
                             )
+                        f_first, f_acc = resolved[r]
                         if acc is None:
-                            acc, aux = step_first(views[d], *args)
+                            acc, aux = f_first(views[d], *args)
                         else:
-                            acc, aux = step_acc(views[d], acc, *args)
+                            acc, aux = f_acc(views[d], acc, *args)
                         aux_acc.append(aux)
                     partials[d] = acc
                 stacked = stack_partials(
@@ -1619,6 +2045,11 @@ class Trainer:
 
         ranges = self._elastic_ranges(plan.num_steps)
 
+        # AOT service: queue this plan's missing executables (concurrent
+        # background compiles) + speculative adjacent rungs; the barrier
+        # below overlaps with the first window's staging.
+        aot_needed = self._aot_stage_plan(plan)
+
         def stage_window(d: int, i: int, data):
             """One device's puts for one window: each worker's arrays plus
             that window's absolute-step rng keys. Runs on the pipeline's
@@ -1644,6 +2075,11 @@ class Trainer:
         with WindowTransferPipeline(
             ranges, gather_window, stage_window, dev_order, meter=meter
         ) as pipe:
+            # kick window 0's gather/puts, then drain the compile barrier
+            # while the staging threads work — compile time and transfer
+            # time overlap instead of stacking
+            pipe.prefetch(0)
+            self._aot_wait_needed(aot_needed, epoch)
             for i, (w0, w1) in enumerate(ranges):
                 data, staged = pipe.get(i)
                 if first_data is None:
@@ -1716,12 +2152,20 @@ class Trainer:
                 compiled_flops,
             )
 
-            # One-time AOT lower+compile for cost analysis — excluded from
-            # the epoch wall (mirrors the fused path's probe_overhead).
+            # Cost analysis reads the ALREADY-COMPILED executable from the
+            # AOT service when it holds this rung (zero extra compiles);
+            # the lower+compile fallback only runs with the service off.
+            # Excluded from the epoch wall either way (mirrors the fused
+            # path's probe_overhead).
             t0 = time.perf_counter()
             d0 = topo.used_device_indices[0]
             r0 = topo.groups[d0][0]
             views = shard_views(self.state.params, topo.devices)
+            b_pad = int(data[r0][0].shape[1])
+            kind = "worker_first_idx" if use_cache else "worker_first"
+            pre = None
+            if self._aot is not None:
+                pre = self._aot.get(self._aot_step_key(kind, b_pad, d0, None))
             if use_cache:
                 idx0, w = data[r0]
                 f = compiled_flops(
@@ -1730,8 +2174,8 @@ class Trainer:
                     *self._device_cache_for(d0),
                     jnp.asarray(idx0[0]), jnp.asarray(w[0]),
                     base_key, jnp.int32(0),
+                    compiled=pre,
                 )
-                b_pad = idx0.shape[1]
             else:
                 x, y, w = data[r0]
                 f = compiled_flops(
@@ -1739,8 +2183,8 @@ class Trainer:
                     views[d0],
                     jnp.asarray(x[0]), jnp.asarray(y[0]), jnp.asarray(w[0]),
                     base_key, jnp.int32(0),
+                    compiled=pre,
                 )
-                b_pad = x.shape[1]
             self._flops_per_padded_example = f / max(b_pad, 1) if f else -1.0
             flops_probe_overhead = time.perf_counter() - t0
 
@@ -1792,12 +2236,17 @@ class Trainer:
             if use_cache
             else self.steps.worker_step_first
         )
+        probe_kind = "worker_first_idx" if use_cache else "worker_first"
         staged = {}
         for d in topo.used_device_indices:
             dev = topo.devices[d]
             for r in topo.groups[d]:
                 gr = self.rank_lo + r
                 cache = self._device_cache_for(d) if use_cache else ()
+                # AOT-compiled probe executable when the service holds this
+                # rung (warm/stage submitted it); lazy jit otherwise
+                b = int(data[r][0].shape[1])
+                fn = self._aot_resolve(probe_kind, b, d, None, probe_step)
                 staged[r] = (
                     cache
                     + tuple(jax.device_put(a[0], dev) for a in data[r])
@@ -1808,10 +2257,12 @@ class Trainer:
                         ),
                     ),
                     d,
+                    fn,
                 )
-        # warm pass: compile + execute everything once, untimed
-        for r, (args, d) in staged.items():
-            _, aux = probe_step(views[d], *args)
+        # warm pass: execute everything once, untimed (with the AOT service
+        # this compiles nothing — the executables already exist)
+        for r, (args, d, fn) in staged.items():
+            _, aux = fn(views[d], *args)
             jax.block_until_ready(aux)
             heartbeat()
 
@@ -1846,7 +2297,7 @@ class Trainer:
                 self._probe_overhead_s, 6
             )
 
-        def timed(d: int, args2):
+        def timed(d: int, args2, fn=probe_step):
             """(corrected wall, raw wall, last partial) of one probe step:
             min-over-reps blocking wall, minus the device's dispatch overhead
             for the corrected value. PAIRED measurements (the closed-loop
@@ -1859,7 +2310,7 @@ class Trainer:
             dt, acc = float("inf"), None
             for _ in range(reps):
                 t0 = time.perf_counter()
-                acc, aux = probe_step(views[d], *args2)
+                acc, aux = fn(views[d], *args2)
                 jax.block_until_ready(aux)
                 dt = min(dt, time.perf_counter() - t0)
             heartbeat()
@@ -1871,11 +2322,11 @@ class Trainer:
         for d in topo.used_device_indices:
             acc = None
             for r in topo.groups[d]:
-                args, _ = staged[r]
+                args, _, fn = staged[r]
                 gr = self.rank_lo + r
                 # probe with the non-donating first-step executable so reps
                 # are safe; each worker is measured standalone
-                dt, dt_raw, acc = timed(d, args)
+                dt, dt_raw, acc = timed(d, args, fn)
                 w_plan = plan.workers[gr]
                 self.timekeeper.add_compute(gr, dt * w_plan.steps)
                 slow_n = float(faults.slow_iters_per_step[gr])
@@ -1905,7 +2356,7 @@ class Trainer:
                     #    counted epoch injects the same strength — the A/B
                     #    contract the bench asserts per arm.
                     zero = jax.device_put(jnp.int32(0), topo.devices[d])
-                    _, raw_clean, _ = timed(d, args[:-1] + (zero,))
+                    _, raw_clean, _ = timed(d, args[:-1] + (zero,), fn)
                     # raw-minus-raw: the per-probe dispatch overhead appears
                     # in both walls and cancels; corrected values would pair
                     # a floored clean leg against an unfloored injected leg
@@ -1937,7 +2388,7 @@ class Trainer:
             for d in topo.used_device_indices:
                 for r in topo.groups[d]:
                     gr = self.rank_lo + r
-                    args, _ = staged[r]
+                    args, _, fn = staged[r]
                     if float(faults.slow_iters_per_step[gr]) != 0:
                         # a worker can be injected on its very first probed
                         # epoch (LuckyFaultInjector seeds iter cost from the
@@ -1945,7 +2396,7 @@ class Trainer:
                         # cold AND injected dt; re-anchor on a zero-slow probe
                         zero = jax.device_put(jnp.int32(0), topo.devices[d])
                         args = args[:-1] + (zero,)
-                    dt, _, _ = timed(d, args)
+                    dt, _, _ = timed(d, args, fn)
                     self.per_example_cost[gr] = max(dt, 1e-9) / max(
                         plan.workers[gr].batch_size, 1
                     )
@@ -1984,7 +2435,7 @@ class Trainer:
         the round-3 injection ramp. Runs on one worker, a handful of probe
         steps — calibration-epoch overhead only."""
         r0 = next(iter(staged))
-        args, d = staged[r0]
+        args, d, fn = staged[r0]
         gr = self.rank_lo + r0
         clean = float(self.per_example_cost[gr]) * max(
             plan.workers[gr].batch_size, 1
@@ -1999,7 +2450,7 @@ class Trainer:
             # RAW wall: both legs of the paired delta below carry the same
             # dispatch overhead, so it cancels; the corrected value's 0.2*dt
             # floor fires only on the short clean leg and would bias the pair
-            return timed(d, test_args)[1]
+            return timed(d, test_args, fn)[1]
 
         for _ in range(4):
             slow_n = max(int(round(clean / max(guess, 1e-12))), 1)

@@ -73,6 +73,12 @@ class WindowTransferPipeline:
         }
         self._inflight[i] = (gather_fut, put_futs)
 
+    def prefetch(self, i: int) -> None:
+        """Kick window i's gather+puts without blocking on them — lets the
+        controller overlap other work (e.g. the AOT compile barrier) with
+        the first window's staging before the dispatch loop starts."""
+        self._launch(i)
+
     def get(self, i: int) -> Tuple[object, Dict[int, object]]:
         """Window i's ``(host_data, {device_index: staged})``; prefetches
         window i+1 before blocking so its gather+puts overlap window i's

@@ -111,6 +111,11 @@ class StepLibrary:
         # activations traded for ~1/3 more FLOPs (the standard TPU memory
         # lever; lets batch/model scale past activation-memory limits).
         self.remat = remat
+        # Optional AOT compile service (runtime/compiler.py), attached by the
+        # engine: superstep_cache_size() folds its compiled superstep
+        # variants into the compile-once accounting, since service-dispatched
+        # supersteps never populate the lazy jit caches.
+        self.aot_service = None
         self._build()
 
     def _apply_train(self, params, x, rng):
@@ -404,13 +409,38 @@ class StepLibrary:
 
     def superstep_cache_size(self) -> int:
         """Compiled (shape-tuple, window-length) superstep variants — the
-        quantity the compile-once contract (tests/test_superstep.py) bounds."""
+        quantity the compile-once contract (tests/test_superstep.py) bounds.
+        Counts both lazy-jit cache entries and AOT-service executables (the
+        service dispatch path never touches the jit caches)."""
         n = 0
         for name in ("group_superstep", "group_superstep_idx"):
             fn = self.__dict__.get(name)
             if fn is not None:
                 n += fn._cache_size()
+        if self.aot_service is not None:
+            n += self.aot_service.count_keys(("group_superstep",))
         return n
+
+    # ------------------------------------------------------- AOT lowerables
+    # The executable families the async compile service can pre-compile,
+    # keyed by the names the engine uses in its service keys. Fused-path
+    # executables are deliberately absent: they compile once per run on a
+    # single shape and gain nothing from the ladder treatment (the fused
+    # sync/FLOPs probes go through service.compile_now with concrete args).
+
+    def aot_lowerables(self) -> Dict[str, Callable]:
+        return {
+            "worker_first": self.worker_step_first,
+            "worker_acc": self.worker_step_acc,
+            "worker_first_idx": self.worker_step_first_idx,
+            "worker_acc_idx": self.worker_step_acc_idx,
+            "worker_first_win": self.worker_step_first_win,
+            "worker_acc_win": self.worker_step_acc_win,
+            "worker_first_win_idx": self.worker_step_first_win_idx,
+            "worker_acc_win_idx": self.worker_step_acc_win_idx,
+            "group_superstep": self.group_superstep,
+            "group_superstep_idx": self.group_superstep_idx,
+        }
 
     # ------------------------------------------------------------ fused path
     # (evaluation is always the sharded fused_eval_step — there is no

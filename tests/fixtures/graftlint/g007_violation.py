@@ -1,0 +1,32 @@
+"""Seeded G007 violations.
+
+Pattern A: a warm scope that compiles by EXECUTING dummy steps — dispatch
+plus block_until_ready in a loop, results discarded — the serial
+execute-to-compile warm wall the AOT compile service replaces.
+
+Pattern B: a blocking ``lowered.compile()`` inside a wall-clock window —
+the wall measures the XLA compiler, not the program.
+"""
+
+import time
+
+import jax
+import numpy as np
+
+step = jax.jit(lambda p, x: (p * x).sum())
+
+
+def warm_ladder(params, ladder, dev):
+    for b in ladder:
+        x = jax.device_put(np.zeros((b, 8), np.float32), dev)
+        out = step(params, x)  # G007: execute-to-compile
+        jax.block_until_ready(out)
+
+
+def timed_epoch(params, x):
+    t0 = time.perf_counter()
+    lowered = step.lower(params, x)
+    lowered.compile()  # G007: the wall times the compiler
+    loss = step(params, x)
+    jax.block_until_ready(loss)
+    return time.perf_counter() - t0

@@ -35,6 +35,7 @@ def codes(findings):
         ("g004_violation.py", "G004", 3),  # float() + np.asarray + if-branch
         ("g005_violation.py", "G005", 1),
         ("g006_violation.py", "G006", 1),
+        ("g007_violation.py", "G007", 2),  # execute-warm loop + timed compile
     ],
 )
 def test_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -118,6 +119,41 @@ def test_g006_warm_scope_is_quiet():
         "    for b in ladder:\n"
         "        x = jax.device_put(np.zeros((b, 8), np.float32), dev)\n"
         "        step(params, x)\n"
+    )
+    # no sync on the dispatched result -> not the G007 execute-to-compile
+    # pattern either (async dispatch; the compile overlaps)
+    assert lint_source(src) == []
+
+
+def test_g007_requires_warm_scope_and_sync():
+    # dispatch + sync in a HOT loop is just training — quiet
+    hot = (
+        "import jax\n"
+        "step = jax.jit(lambda p, x: (p * x).sum())\n"
+        "def train_epoch(params, batches):\n"
+        "    for x in batches:\n"
+        "        out = step(params, x)\n"
+        "        jax.block_until_ready(out)\n"
+    )
+    assert lint_source(hot) == []
+    # the AOT idiom in a warm scope — lower(abstract).compile(), no
+    # execution, no timer — is the sanctioned replacement and stays quiet
+    aot = (
+        "import jax\n"
+        "step = jax.jit(lambda p, x: (p * x).sum())\n"
+        "def warm_ladder(pspec, specs, service):\n"
+        "    for spec in specs:\n"
+        "        service.submit((\"step\", spec.shape), step, (pspec, spec))\n"
+    )
+    assert lint_source(aot) == []
+
+
+def test_g007_compile_outside_timed_window_is_quiet():
+    src = (
+        "import jax\n"
+        "step = jax.jit(lambda x: x + 1)\n"
+        "def _compile_job(spec):\n"
+        "    return step.lower(spec).compile()\n"
     )
     assert lint_source(src) == []
 

@@ -4,9 +4,10 @@ Two halves, one contract — keep the DBS loop's timing signal trustworthy and
 its XLA compile count bounded:
 
 * :mod:`.linter` / :mod:`.rules` — an AST linter with repo-specific rules
-  (G001-G005) for the structural perf bugs this repo has actually shipped:
+  (G001-G008) for the structural perf bugs this repo has actually shipped:
   jit-in-hot-scope recompile churn, un-synced walls around async dispatches,
-  off-ladder batch shapes, tracer coercion, use-after-donation.
+  off-ladder batch shapes, tracer coercion, use-after-donation, per-step
+  transfers, execute-to-compile warms, unattributable recorded walls.
 * :mod:`.guards` — runtime guards hooked on ``jax.monitoring`` compile
   events: :func:`~.guards.compile_budget` asserts a compile bound over a code
   region cheaply, and :class:`~.guards.CompileTracker` lets the engine log

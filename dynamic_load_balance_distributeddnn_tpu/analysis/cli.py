@@ -25,7 +25,9 @@ def build_parser() -> argparse.ArgumentParser:
         description=(
             "TPU/JAX correctness linter for this repo: jit-in-hot-scope "
             "(G001), unsynced walls (G002), off-ladder batch shapes (G003), "
-            "tracer coercion (G004), use-after-donation (G005)."
+            "tracer coercion (G004), use-after-donation (G005), per-step "
+            "puts (G006), execute-to-compile warms (G007), unattributable "
+            "recorded walls (G008)."
         ),
     )
     parser.add_argument(

@@ -1,4 +1,4 @@
-"""graftlint rules G001-G007.
+"""graftlint rules G001-G008.
 
 Each rule encodes one structural TPU/JAX perf-bug class this repo has
 actually shipped (the motivating incident is listed in README "Static
@@ -1117,6 +1117,160 @@ class RuleG007:
         yield from self._check_timed_compiles(ctx)
 
 
+# --------------------------------------------------------------------------
+# G008 — bare wall-clock delta recorded as a metric without span coverage
+
+
+class RuleG008:
+    code = "G008"
+    summary = (
+        "bare perf_counter/time wall recorded as a metric outside "
+        "TimeKeeper/graftscope-span coverage"
+    )
+    fix_hint = (
+        "measure the region under a graftscope span (obs/trace.py — the "
+        "wall then lands in the trace and `graftscope summarize` can "
+        "attribute it) or aggregate it through TimeKeeper/HostOverheadMeter "
+        "before it reaches the recorder; a bare wall fed straight into a "
+        "recorded series is invisible to epoch attribution"
+    )
+
+    # Metric-recording sinks: the per-epoch series entry point, or anything
+    # reached through a `recorder` handle (meta subscript writes included).
+    _SINK_TAILS = {"record_epoch"}
+
+    @staticmethod
+    def _is_recorder_path(name: Optional[str]) -> bool:
+        return bool(name) and "recorder" in name.split(".")
+
+    @classmethod
+    def _is_sink_call(cls, node: ast.Call) -> bool:
+        name = call_name(node)
+        if name is None:
+            return False
+        return _attr_tail(name) in cls._SINK_TAILS or cls._is_recorder_path(name)
+
+    @staticmethod
+    def _contains_wall_delta(expr: ast.expr) -> bool:
+        """Does this RHS contain ``<clock>() - <name>`` anywhere (also nested
+        in min()/round()/arithmetic, the repo's usual wall idioms)?"""
+        for n in ast.walk(expr):
+            if (
+                isinstance(n, ast.BinOp)
+                and isinstance(n.op, ast.Sub)
+                and isinstance(n.left, ast.Call)
+                and call_name(n.left) in _CLOCK_CALLS
+                and isinstance(n.right, ast.Name)
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _span_covered(node: ast.AST, ctx, fn) -> bool:
+        """Is this statement lexically inside a ``with *.span(...)`` block?
+        A wall measured under a span is already attributable in the trace —
+        the sanctioned bare-wall form."""
+        cur = ctx.parents.get(node)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    if (
+                        isinstance(item.context_expr, ast.Call)
+                        and _attr_tail(call_name(item.context_expr)) == "span"
+                    ):
+                        return True
+            cur = ctx.parents.get(cur)
+        return False
+
+    @staticmethod
+    def _bind_tokens(stmt: ast.Assign) -> Set[str]:
+        """Identifiers this assignment taints: plain/dotted Name targets
+        (their attribute tail too) and the CONTAINER of a subscript target
+        (``extras["k"] = wall`` taints ``extras``)."""
+        out: Set[str] = set()
+        for t in stmt.targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            name = dotted_name(base)
+            if name:
+                out.add(name)
+                out.add(_attr_tail(name))
+        return out
+
+    def _tainted(self, fn: ast.AST, ctx) -> Set[str]:
+        assigns: List[ast.Assign] = [
+            n
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Assign)
+            and _innermost_function(n, ctx.parents) is fn
+        ]
+        tainted: Set[str] = set()
+        for stmt in assigns:
+            if self._contains_wall_delta(stmt.value) and not self._span_covered(
+                stmt, ctx, fn
+            ):
+                tainted |= self._bind_tokens(stmt)
+        for _ in range(4):  # local chains are short
+            changed = False
+            for stmt in assigns:
+                if identifiers_in(stmt.value) & tainted:
+                    new = self._bind_tokens(stmt) - tainted
+                    if new:
+                        tainted |= new
+                        changed = True
+            if not changed:
+                break
+        return tainted
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        fns = [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in fns:
+            tainted = self._tainted(fn, ctx)
+            if not tainted:
+                continue
+            for node in ast.walk(fn):
+                if _innermost_function(node, ctx.parents) is not fn:
+                    continue
+                if isinstance(node, ast.Call) and self._is_sink_call(node):
+                    values = list(node.args) + [kw.value for kw in node.keywords]
+                    hit = next(
+                        (v for v in values if identifiers_in(v) & tainted), None
+                    )
+                    if hit is not None:
+                        yield _finding(
+                            self.code,
+                            ctx,
+                            node,
+                            f"`{call_name(node)}` in `{fn.name}` records a "
+                            "bare wall-clock delta that never went through a "
+                            "graftscope span or TimeKeeper — the metric is "
+                            "unattributable in the trace",
+                            self.fix_hint,
+                        )
+                elif isinstance(node, ast.Assign):
+                    sub_sinks = [
+                        t
+                        for t in node.targets
+                        if isinstance(t, ast.Subscript)
+                        and self._is_recorder_path(dotted_name(t.value))
+                    ]
+                    if sub_sinks and identifiers_in(node.value) & tainted:
+                        yield _finding(
+                            self.code,
+                            ctx,
+                            node,
+                            f"recorder metadata write in `{fn.name}` stores a "
+                            "bare wall-clock delta that never went through a "
+                            "graftscope span or TimeKeeper",
+                            self.fix_hint,
+                        )
+
+
 # G007 reuses G002's timed-window extraction; share one instance.
 RULES_G002_WINDOWS = RuleG002()
 
@@ -1130,5 +1284,6 @@ RULES: Dict[str, object] = {
         RuleG005(),
         RuleG006(),
         RuleG007(),
+        RuleG008(),
     )
 }

@@ -126,12 +126,16 @@ def rebalance(
     if np.any(t <= 0):
         raise ValueError("node_times must be positive")
 
+    from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
     from dynamic_load_balance_distributeddnn_tpu.runtime import native_rebalance
 
-    nat = native_rebalance(t, p, global_batch, max_share)
-    if nat is not None:
-        return nat
-    return rebalance_py(t, p, global_batch, max_share)
+    # graftscope: the solver's own cost inside the plan_solve phase (also
+    # records which implementation — native C++ or numpy — answered)
+    with get_tracer().span("rebalance", cat="solve"):
+        nat = native_rebalance(t, p, global_batch, max_share)
+        if nat is not None:
+            return nat
+        return rebalance_py(t, p, global_batch, max_share)
 
 
 def rebalance_py(

@@ -298,6 +298,24 @@ class Config:
                                        # 16 already amortizes dispatch 16x.
                                        # Windowed (multi-device) mode streams
                                        # by stream_chunk_steps as before.
+    trace: str = "off"                 # graftscope span tracing (obs/trace.py):
+                                       # "on" = unbounded event buffer, "ring"
+                                       # = keep the last trace_ring events
+                                       # (long runs), "off" = zero-cost no-op
+                                       # (every call site degrades to one
+                                       # attribute check; no jax is touched,
+                                       # so disabled mode is sentinel-silent
+                                       # under the compile guards). Traces
+                                       # save as Chrome-trace JSON under
+                                       # trace_dir at end of run — open in
+                                       # ui.perfetto.dev or summarize with
+                                       # the `graftscope` CLI.
+    trace_ring: int = 1_000_000        # ring-mode event cap (~100 bytes/event)
+    trace_dir: str = "./traces"        # where run traces are written
+    trace_annotations: bool = False    # ALSO wrap each span in a
+                                       # jax.profiler.TraceAnnotation so host
+                                       # spans line up with device timelines
+                                       # inside a --profile_dir trace
     packed: str = "auto"               # "auto"|"on"|"off": single-device
                                        # packed epochs — when every worker
                                        # lives on ONE chip (the contention
@@ -337,6 +355,10 @@ class Config:
             raise ValueError("packed must be 'auto', 'on' or 'off'")
         if self.superstep not in ("auto", "on", "off"):
             raise ValueError("superstep must be 'auto', 'on' or 'off'")
+        if self.trace not in ("on", "off", "ring"):
+            raise ValueError("trace must be 'on', 'off' or 'ring'")
+        if self.trace_ring < 1:
+            raise ValueError("trace_ring must be >= 1")
         if self.superstep_window < 1:
             raise ValueError("superstep_window must be >= 1")
         if self.aot_pool < 0:
@@ -512,6 +534,18 @@ def get_parser() -> argparse.ArgumentParser:
                    help="Max steps per compiled superstep window (scan mode "
                         "unrolls fully for bitwise parity; compile time "
                         "scales with this).")
+    p.add_argument("--trace", type=str, default=d.trace,
+                   choices=["on", "off", "ring"],
+                   help="graftscope span tracing: on = full buffer, ring = "
+                        "last trace_ring events; Chrome-trace JSON saved "
+                        "under trace_dir (summarize with `graftscope`).")
+    p.add_argument("--trace_ring", type=int, default=d.trace_ring)
+    p.add_argument("--trace_dir", type=str, default=d.trace_dir)
+    p.add_argument("--trace_annotations", type=str2bool,
+                   default=d.trace_annotations,
+                   help="Bridge spans into jax.profiler.TraceAnnotation so "
+                        "host phases line up with device timelines in a "
+                        "--profile_dir trace.")
     p.add_argument("--packed", type=str, default=d.packed,
                    choices=["auto", "on", "off"],
                    help="Single-device packed epochs: concat all workers' "

@@ -1,4 +1,21 @@
 from dynamic_load_balance_distributeddnn_tpu.obs.logging import init_logger
 from dynamic_load_balance_distributeddnn_tpu.obs.recorder import MetricsRecorder
+from dynamic_load_balance_distributeddnn_tpu.obs.registry import MetricsRegistry
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
+    Tracer,
+    attribution,
+    configure as configure_tracer,
+    get_tracer,
+    load_trace,
+)
 
-__all__ = ["init_logger", "MetricsRecorder"]
+__all__ = [
+    "init_logger",
+    "MetricsRecorder",
+    "MetricsRegistry",
+    "Tracer",
+    "attribution",
+    "configure_tracer",
+    "get_tracer",
+    "load_trace",
+]

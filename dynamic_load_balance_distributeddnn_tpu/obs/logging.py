@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import os
 import socket
+import time
 
 from dynamic_load_balance_distributeddnn_tpu.config import Config
 
@@ -20,6 +21,19 @@ _FORMAT = (
     "%(asctime)s [%(world_size)s:%(lr)s:dbs_%(dbs)s:ft_%(ft)s] "
     "[%(filename)s:%(lineno)d] %(levelname)s %(message)s"
 )
+
+
+def _has_checkpoint(ckpt_dir: str) -> bool:
+    """Structural twin of ``restore_checkpoint``'s found-a-checkpoint
+    condition, cheap enough for logger init (no orbax import): the orbax
+    manager creates the directory and writes per-step entries only on save,
+    so a non-empty ckpt_dir means at least one save landed."""
+    if not ckpt_dir or not os.path.isdir(ckpt_dir):
+        return False
+    try:
+        return any(os.scandir(ckpt_dir))
+    except OSError:
+        return False
 
 
 def init_logger(cfg: Config, rank: int = 0, to_file: bool = True) -> logging.LoggerAdapter:
@@ -43,9 +57,27 @@ def init_logger(cfg: Config, rank: int = 0, to_file: bool = True) -> logging.Log
     if to_file:
         os.makedirs(cfg.log_dir, exist_ok=True)
         path = os.path.join(cfg.log_dir, cfg.base_filename().format(rank) + ".log")
-        fh = logging.FileHandler(path, "w+")
+        # A checkpoint-resumable run that re-inits its logger must not
+        # truncate the history it is resuming (the old "w+" lost every
+        # pre-crash line); append there, and tag each (re)start so the log
+        # reads as one run with visible restart boundaries. "Resuming" is
+        # keyed on a checkpoint ACTUALLY existing (the condition under which
+        # the engine's _maybe_restore restores), not just on ckpt_dir being
+        # set — a deliberately fresh run of a checkpointable config (dir
+        # cleaned, or never saved) keeps truncate semantics, as does every
+        # non-checkpointed config (a re-run of the same config IS a fresh
+        # run — the reference's behavior, dbs_logging.py:29).
+        resuming = _has_checkpoint(cfg.ckpt_dir) and os.path.exists(path)
+        fh = logging.FileHandler(path, "a" if resuming else "w")
         fh.setFormatter(formatter)
         logger.addHandler(fh)
+        start_kind = "resumed" if resuming else "started"
+        # emitted through the handler so the tag carries the run-context
+        # format fields, as the first line of this (re)start's segment
+        logging.LoggerAdapter(logger, extra).info(
+            f"==== run {start_kind} (pid {os.getpid()}, "
+            f"{time.strftime('%Y-%m-%dT%H:%M:%S')}) ===="
+        )
 
     return logging.LoggerAdapter(logger, extra)
 

@@ -91,4 +91,28 @@ class MetricsRecorder:
         return npy_path
 
     def last(self, key: str):
-        return self.data[key][-1] if self.data[key] else None
+        """Last recorded value of a series, or None. Optional series
+        (``examples_per_s``, ``host_dispatch_s``, ...) exist only on the
+        paths that emit them, so an absent key is an answerable question
+        (None), not a KeyError."""
+        series = self.data.get(key)
+        return series[-1] if series else None
+
+    @classmethod
+    def load(cls, npy_path: str) -> "MetricsRecorder":
+        """Round-trip a saved artifact: the pickled ``.npy`` dict payload
+        (``allow_pickle=True`` — np.save wraps the dict in an object array)
+        plus, when present, the JSON sidecar's ``_meta`` (run-level facts
+        live only there; the .npy keeps reference parity). Accepts the
+        ``.npy`` path or the bare stem."""
+        if not npy_path.endswith(".npy"):
+            npy_path = npy_path + ".npy"
+        payload = np.load(npy_path, allow_pickle=True).item()
+        rec = cls()
+        rec.data = {k: list(v) for k, v in payload.items() if k != "_meta"}
+        json_path = npy_path[: -len(".npy")] + ".json"
+        if os.path.exists(json_path):
+            with open(json_path) as f:
+                sidecar = json.load(f)
+            rec.meta = dict(sidecar.get("_meta", {}))
+        return rec

@@ -1,0 +1,102 @@
+"""Unified metrics registry: one handle over the run's observability surfaces.
+
+Before graftscope, a caller wanting "where did this run's time go" had to
+know four unrelated objects: the :class:`~..obs.recorder.MetricsRecorder`
+(nine per-epoch series + extras), the
+:class:`~..balance.timing.HostOverheadMeter` (dispatch/put walls), the
+compile guards (:mod:`..analysis.guards` counters + per-engine
+``CompileTracker``), and the AOT compile service's stats. The registry binds
+them behind one object the engine owns:
+
+* ``registry.last(name)`` / ``registry.series(name)`` — recorder access with
+  the None-for-absent contract (optional series like ``examples_per_s``
+  exist only on some paths);
+* ``registry.snapshot()`` — one JSON-safe dict of everything measurable
+  *right now*: recorder last-values, host-meter walls, compile counts
+  (foreground/background), AOT service stats, tracer state. The engine logs
+  it at end of run; tests and the bench read single keys out of it;
+* meters registered once (``attach(...)``) so future surfaces (a new meter,
+  a new service) join the snapshot without new plumbing at every call site.
+
+The registry holds *references*, not copies — it is a view, never a second
+source of truth, so it can never drift from the objects it unifies.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from dynamic_load_balance_distributeddnn_tpu.obs.recorder import MetricsRecorder
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import Tracer, get_tracer
+
+
+class MetricsRegistry:
+    def __init__(
+        self,
+        recorder: Optional[MetricsRecorder] = None,
+        tracer: Optional[Tracer] = None,
+    ):
+        self.recorder = recorder if recorder is not None else MetricsRecorder()
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.host_meter = None  # balance.timing.HostOverheadMeter
+        self.compile_tracker = None  # analysis.guards.CompileTracker
+        self.aot_service = None  # runtime.compiler.AOTCompileService
+
+    def attach(self, **surfaces) -> "MetricsRegistry":
+        """Register observability surfaces by their well-known slot name
+        (``host_meter``, ``compile_tracker``, ``aot_service``). Unknown
+        names raise — a typo'd attach would silently hollow the snapshot."""
+        for name, obj in surfaces.items():
+            if name not in ("host_meter", "compile_tracker", "aot_service"):
+                raise ValueError(f"unknown registry surface {name!r}")
+            setattr(self, name, obj)
+        return self
+
+    # ------------------------------------------------------- recorder facade
+
+    def series(self, name: str) -> List:
+        """A recorder series by name ([] for a series never recorded)."""
+        return self.recorder.data.get(name, [])
+
+    def last(self, name: str):
+        """Last recorded value of a series (None when absent/empty)."""
+        return self.recorder.last(name)
+
+    # ------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> Dict:
+        """JSON-safe point-in-time view across every attached surface."""
+        out: Dict = {
+            "recorder": {
+                k: self.recorder.last(k)
+                for k, v in self.recorder.data.items()
+                if v
+            },
+            "trace": {
+                "mode": self.tracer.mode,
+                "events": len(self.tracer.events()) if self.tracer.enabled else 0,
+            },
+        }
+        if self.host_meter is not None:
+            m = self.host_meter
+            out["host"] = {
+                "dispatch_s": round(m.dispatch_s, 6),
+                "put_s": round(m.put_s, 6),
+                "dispatches": m.dispatches,
+            }
+        # process-wide compile counters are always available (guards installs
+        # its jax.monitoring listener lazily)
+        from dynamic_load_balance_distributeddnn_tpu.analysis.guards import (
+            background_compile_count,
+            compile_count,
+        )
+
+        total = compile_count()
+        bg = background_compile_count()
+        out["compiles"] = {"total": total, "background": bg, "foreground": total - bg}
+        if self.aot_service is not None:
+            out["aot"] = {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in self.aot_service.stats().items()
+            }
+        return out

@@ -1,0 +1,148 @@
+"""``graftscope`` console entry point: read a Chrome-trace JSON written by
+the span tracer (``--trace on|ring``) and answer "where did the wall go"
+without opening Perfetto.
+
+Usage::
+
+    graftscope summarize traces/run.trace.json            # per-phase table
+    graftscope summarize traces/run.trace.json --epoch 3  # one epoch only
+    graftscope diff before.trace.json after.trace.json    # phase deltas
+    graftscope summarize run.trace.json --json            # machine-readable
+
+Exit status: 0 on success, 2 on usage/IO errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import attribution, load_trace
+
+
+def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+    def line(cells):
+        return "  ".join(str(c).ljust(w) for c, w in zip(cells, widths)).rstrip()
+
+    out = [line(header), line(["-" * w for w in widths])]
+    out += [line(r) for r in rows]
+    return "\n".join(out)
+
+
+def summarize(path: str, epoch: Optional[int] = None, as_json: bool = False) -> str:
+    att = attribution(load_trace(path))
+    epochs = att["epochs"]
+    if epoch is not None:
+        epochs = {k: v for k, v in epochs.items() if int(k) == epoch}
+        if not epochs:
+            raise ValueError(f"epoch {epoch} not present in {path}")
+    if as_json:
+        return json.dumps(
+            {"epochs": epochs, "phase_totals_s": att["phase_totals_s"],
+             "coverage_min": att["coverage_min"]}
+        )
+    out = []
+    for ep, info in sorted(epochs.items(), key=lambda kv: int(kv[0])):
+        wall = info["wall_s"]
+        rows = [
+            [name, f"{secs:.4f}", f"{100.0 * secs / wall:5.1f}%" if wall else "-"]
+            for name, secs in sorted(
+                info["phases"].items(), key=lambda kv: -kv[1]
+            )
+        ]
+        unattributed = wall - sum(info["phases"].values())
+        rows.append(
+            ["(unattributed)", f"{unattributed:.4f}",
+             f"{100.0 * unattributed / wall:5.1f}%" if wall else "-"]
+        )
+        cov = info["coverage"]
+        head = f"epoch {ep}: wall {wall:.4f}s"
+        if cov is not None:
+            head += f", attribution {cov * 100:.1f}%"
+        out.append(head)
+        out.append(_fmt_table(rows, ["phase", "seconds", "% wall"]))
+        out.append("")
+    totals = att["phase_totals_s"]
+    if totals and epoch is None:
+        rows = [
+            [name, f"{secs:.4f}"]
+            for name, secs in sorted(totals.items(), key=lambda kv: -kv[1])
+        ]
+        out.append("run totals:")
+        out.append(_fmt_table(rows, ["phase", "seconds"]))
+        if att["coverage_min"] is not None:
+            out.append(f"worst-epoch attribution: {att['coverage_min'] * 100:.1f}%")
+    return "\n".join(out).rstrip()
+
+
+def diff(path_a: str, path_b: str, as_json: bool = False) -> str:
+    """Phase-total deltas B - A: the first stop of every perf PR review
+    ('which phase did this change actually move?')."""
+    a = attribution(load_trace(path_a))["phase_totals_s"]
+    b = attribution(load_trace(path_b))["phase_totals_s"]
+    names = sorted(set(a) | set(b))
+    deltas: Dict[str, Dict] = {}
+    for name in names:
+        va, vb = a.get(name, 0.0), b.get(name, 0.0)
+        deltas[name] = {
+            "a_s": round(va, 6),
+            "b_s": round(vb, 6),
+            "delta_s": round(vb - va, 6),
+            "ratio": round(vb / va, 4) if va > 0 else None,
+        }
+    if as_json:
+        return json.dumps(deltas)
+    rows = [
+        [
+            name,
+            f"{d['a_s']:.4f}",
+            f"{d['b_s']:.4f}",
+            f"{d['delta_s']:+.4f}",
+            f"{d['ratio']:.3f}x" if d["ratio"] is not None else "new",
+        ]
+        for name, d in sorted(deltas.items(), key=lambda kv: kv[1]["delta_s"])
+    ]
+    return _fmt_table(rows, ["phase", "A (s)", "B (s)", "delta", "B/A"])
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftscope",
+        description=(
+            "Summarize/diff graftscope traces (Chrome-trace JSON from "
+            "--trace on|ring; open the same file in ui.perfetto.dev for "
+            "the timeline view)."
+        ),
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("summarize", help="per-phase epoch-attribution table")
+    s.add_argument("trace")
+    s.add_argument("--epoch", type=int, default=None)
+    s.add_argument("--json", action="store_true")
+    d = sub.add_parser("diff", help="phase-total deltas between two traces")
+    d.add_argument("trace_a")
+    d.add_argument("trace_b")
+    d.add_argument("--json", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.cmd == "summarize":
+            print(summarize(args.trace, epoch=args.epoch, as_json=args.json))
+        else:
+            print(diff(args.trace_a, args.trace_b, as_json=args.json))
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"graftscope: {exc}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,371 @@
+"""graftscope: run-wide span tracing with Perfetto-exportable output.
+
+The DBS feedback loop re-partitions from *measurements*, yet the repo's
+timing story used to be fragmented across the recorder's per-epoch series,
+``HostOverheadMeter``, ``CompileTracker`` events and a dozen bare
+``perf_counter()`` walls — no single artifact said where an epoch's wall
+actually went. This module is that artifact's source: a span tracer the hot
+paths call around every phase (plan/solve, AOT barrier, dispatch, transfer,
+probe, validation), whose buffer exports as Chrome-trace-event JSON loadable
+in Perfetto/chrome://tracing, summarizable by the ``graftscope`` CLI, and
+joinable with device timelines via an optional ``jax.profiler`` annotation
+bridge.
+
+Design constraints, in order:
+
+* **near-zero cost when disabled** — the tracer ships enabled in no default
+  config, so every call site must degrade to one attribute check. A disabled
+  ``span()`` returns a shared singleton no-op context manager: no object,
+  no dict, no closure is allocated (tests assert zero allocations). Call
+  sites therefore pass span attributes as an optional ``args`` dict rather
+  than ``**kwargs`` (a kwargs dict would be materialized by the *call*
+  before the enabled check can run).
+* **thread-aware** — events record the OS thread id and name at emit time;
+  the AOT compile pool, the transfer pipeline's staging threads, and the
+  controller each get their own named track in Perfetto.
+* **bounded when asked** — ``mode="ring"`` keeps the last ``ring_size``
+  events in a deque (long runs can trace forever and keep the tail);
+  ``mode="on"`` keeps everything.
+* **no wall-clock surprises** — timestamps come from ``time.perf_counter``
+  (monotonic), rebased to the tracer's epoch so exported ``ts`` values are
+  small; span emission never syncs a device and never touches jax unless
+  the annotation bridge is explicitly enabled.
+
+Event tuples are ``(name, cat, ph, ts_us, dur_us, tid, args)`` with
+``ph in ("X", "i", "C")`` — complete spans, instant events (watchdog
+heartbeats), counters. ``args`` additionally carries the tracer's *current
+epoch* (``set_epoch``) so offline attribution can group spans per epoch
+without parsing span nesting across threads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from functools import wraps
+from typing import Dict, List, Optional, Tuple
+
+# Phase taxonomy: spans with cat="phase" are the NON-OVERLAPPING controller
+# segments that tile an epoch span (cat="epoch"); attribution() sums them.
+# Deeper instrumentation uses the other categories so nested spans never
+# double-count into the per-phase table.
+EPOCH_CAT = "epoch"
+PHASE_CAT = "phase"
+
+
+class _NullSpan:
+    """Shared do-nothing context manager for the disabled path. A singleton:
+    ``tracer.span(...)`` returns THIS object when tracing is off, so the
+    disabled fast path allocates nothing per call."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: records on ``__exit__``. Separate from the tracer so
+    spans can nest freely and cross threads (each span captures its own
+    thread id at entry)."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0", "_jax_ctx")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = 0.0
+        self._jax_ctx = None
+
+    def __enter__(self):
+        if self._tracer._jax_bridge:
+            try:
+                import jax
+
+                self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
+            except Exception:  # pragma: no cover - profiler not active/available
+                self._jax_ctx = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if self._jax_ctx is not None:
+            try:
+                self._jax_ctx.__exit__(*exc)
+            except Exception:  # pragma: no cover
+                pass
+        self._tracer._emit(self.name, self.cat, "X", self._t0, t1 - self._t0, self.args)
+        return False
+
+
+class Tracer:
+    """Span/instant/counter recorder with Chrome-trace export.
+
+    ``mode``: ``"off"`` (every call degrades to the singleton no-op),
+    ``"on"`` (unbounded buffer), ``"ring"`` (keep the last ``ring_size``
+    events). ``jax_annotations=True`` additionally wraps each span in a
+    ``jax.profiler.TraceAnnotation`` so host spans line up with device
+    timelines when a profiler trace (``--profile_dir``) is active.
+    """
+
+    def __init__(
+        self,
+        mode: str = "off",
+        ring_size: int = 1_000_000,
+        jax_annotations: bool = False,
+    ):
+        self.configure(mode, ring_size=ring_size, jax_annotations=jax_annotations)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def configure(
+        self,
+        mode: str,
+        ring_size: int = 1_000_000,
+        jax_annotations: bool = False,
+    ) -> "Tracer":
+        if mode not in ("off", "on", "ring"):
+            raise ValueError(f"trace mode must be 'off', 'on' or 'ring', got {mode!r}")
+        self.mode = mode
+        self.enabled = mode != "off"
+        self._jax_bridge = bool(jax_annotations) and self.enabled
+        # deque.append is atomic under the GIL — pipeline/compile-pool
+        # threads emit without a lock on the hot path
+        self._events: deque = deque(maxlen=ring_size if mode == "ring" else None)
+        self._epoch_base = time.perf_counter()
+        self._current_epoch: Optional[int] = None
+        self._thread_names: Dict[int, str] = {}
+        return self
+
+    def reset(self) -> None:
+        """Drop buffered events; keep the mode."""
+        self._events.clear()
+        self._epoch_base = time.perf_counter()
+        self._current_epoch = None
+
+    def set_epoch(self, epoch: Optional[int]) -> None:
+        """Stamp subsequent events with this epoch index (attribution key).
+        The engine sets it at each epoch boundary; None = outside any epoch
+        (warm-up, teardown)."""
+        self._current_epoch = epoch
+
+    # -------------------------------------------------------------- emitters
+
+    def span(self, name: str, cat: str = PHASE_CAT, args: Optional[dict] = None):
+        """Context manager timing one region. Disabled mode returns the
+        shared no-op singleton — pass attributes via the ``args`` dict (not
+        ``**kwargs``, which would allocate before this check could run)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    def traced(self, name: Optional[str] = None, cat: str = PHASE_CAT):
+        """Decorator twin of :meth:`span` — times every call of the wrapped
+        function under ``name`` (default: the function's __qualname__)."""
+
+        def deco(fn):
+            label = name or fn.__qualname__
+
+            @wraps(fn)
+            def wrapper(*a, **kw):
+                if not self.enabled:
+                    return fn(*a, **kw)
+                with _Span(self, label, cat, None):
+                    return fn(*a, **kw)
+
+            return wrapper
+
+        return deco
+
+    def instant(self, name: str, cat: str = "instant", args: Optional[dict] = None) -> None:
+        """Zero-duration marker (watchdog heartbeats, faults, rebalances)."""
+        if not self.enabled:
+            return
+        self._emit(name, cat, "i", time.perf_counter(), 0.0, args)
+
+    def counter(self, name: str, value: float, cat: str = "counter") -> None:
+        """Counter sample (compile counts, queue depths) — renders as a
+        stacked track in Perfetto."""
+        if not self.enabled:
+            return
+        self._emit(name, cat, "C", time.perf_counter(), 0.0, {"value": float(value)})
+
+    def _emit(self, name, cat, ph, t0: float, dur: float, args) -> None:
+        tid = threading.get_ident()
+        if tid not in self._thread_names:
+            # dict writes are GIL-atomic; a benign race re-writes the same name
+            self._thread_names[tid] = threading.current_thread().name
+        epoch = self._current_epoch
+        if epoch is not None:
+            args = dict(args) if args else {}
+            args.setdefault("epoch", epoch)
+        self._events.append(
+            (
+                name,
+                cat,
+                ph,
+                (t0 - self._epoch_base) * 1e6,  # us, Chrome-trace's unit
+                dur * 1e6,
+                tid,
+                args,
+            )
+        )
+
+    # --------------------------------------------------------------- export
+
+    def events(self) -> List[Tuple]:
+        return list(self._events)
+
+    def chrome_events(self) -> List[dict]:
+        """Buffered events as Chrome-trace-event dicts (the ``traceEvents``
+        list), plus thread-name metadata so Perfetto labels the tracks.
+
+        Snapshots (``list(...)`` — one C-level call, atomic under the GIL)
+        before the Python-level loops: background threads (AOT pool,
+        transfer pipeline) may still be emitting, and iterating the live
+        deque/dict while they append raises RuntimeError mid-export."""
+        pid = os.getpid()
+        out: List[dict] = []
+        for tid, tname in sorted(list(self._thread_names.items())):
+            out.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": tname},
+                }
+            )
+        for name, cat, ph, ts, dur, tid, args in list(self._events):
+            ev = {
+                "name": name,
+                "cat": cat,
+                "ph": ph,
+                "ts": round(ts, 3),
+                "pid": pid,
+                "tid": tid,
+            }
+            if ph == "X":
+                ev["dur"] = round(dur, 3)
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return out
+
+    def save(self, path: str) -> str:
+        """Write the buffer as Chrome-trace JSON (open in Perfetto via
+        ui.perfetto.dev or chrome://tracing). Returns the path."""
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        payload = {"traceEvents": self.chrome_events(), "displayTimeUnit": "ms"}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return path
+
+    def summary(self) -> Dict:
+        """Per-epoch per-phase attribution of the buffered events (see
+        :func:`attribution`)."""
+        return attribution(self.chrome_events())
+
+
+# ---------------------------------------------------------------- attribution
+
+
+def load_trace(path: str) -> List[dict]:
+    """Chrome-trace JSON -> the traceEvents list (accepts both the object
+    form this module writes and a bare event array)."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return list(data.get("traceEvents", []))
+    return list(data)
+
+
+def attribution(events: List[dict]) -> Dict:
+    """Per-epoch wall attribution from Chrome-trace events.
+
+    Epoch spans (cat=="epoch") define each epoch's wall; phase spans
+    (cat=="phase") carrying the same ``args.epoch`` tile it — the
+    instrumentation contract keeps phases non-overlapping on the controller
+    thread, so their plain sum is the attributed wall. Returns::
+
+        {"epochs": {epoch: {"wall_s", "phases": {name: s}, "coverage"}},
+         "phase_totals_s": {name: s},
+         "coverage_min": float | None}
+
+    ``coverage`` is attributed/wall per epoch; ``coverage_min`` the worst
+    epoch — the quantity the bench's >=0.95 acceptance reads.
+    """
+    walls: Dict[int, float] = {}
+    phases: Dict[int, Dict[str, float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        epoch = (ev.get("args") or {}).get("epoch")
+        if epoch is None:
+            continue
+        dur_s = float(ev.get("dur", 0.0)) / 1e6
+        if ev.get("cat") == EPOCH_CAT:
+            walls[epoch] = walls.get(epoch, 0.0) + dur_s
+        elif ev.get("cat") == PHASE_CAT:
+            phases.setdefault(epoch, {})
+            phases[epoch][ev["name"]] = phases[epoch].get(ev["name"], 0.0) + dur_s
+    epochs: Dict[int, Dict] = {}
+    totals: Dict[str, float] = {}
+    coverage_min: Optional[float] = None
+    for epoch in sorted(walls):
+        per = phases.get(epoch, {})
+        wall = walls[epoch]
+        cov = (sum(per.values()) / wall) if wall > 0 else None
+        epochs[epoch] = {
+            "wall_s": round(wall, 6),
+            "phases": {k: round(v, 6) for k, v in sorted(per.items())},
+            "coverage": round(cov, 4) if cov is not None else None,
+        }
+        for k, v in per.items():
+            totals[k] = totals.get(k, 0.0) + v
+        if cov is not None:
+            coverage_min = cov if coverage_min is None else min(coverage_min, cov)
+    return {
+        "epochs": epochs,
+        "phase_totals_s": {k: round(v, 6) for k, v in sorted(totals.items())},
+        "coverage_min": round(coverage_min, 4) if coverage_min is not None else None,
+    }
+
+
+# -------------------------------------------------------------- global tracer
+
+# One process-wide tracer: the instrumented modules (engine, pipeline, AOT
+# service, solver, watchdog) fetch it by function call so a single configure()
+# — from config or tests — flips every call site at once. Ships disabled.
+_TRACER = Tracer(mode="off")
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def configure(
+    mode: str, ring_size: int = 1_000_000, jax_annotations: bool = False
+) -> Tracer:
+    """(Re)configure the process-wide tracer; returns it. ``mode="off"``
+    restores the zero-cost disabled state (buffer dropped)."""
+    return _TRACER.configure(
+        mode, ring_size=ring_size, jax_annotations=jax_annotations
+    )

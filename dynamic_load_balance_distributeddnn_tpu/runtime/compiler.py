@@ -54,6 +54,7 @@ from typing import Callable, Deque, Dict, Hashable, List, Optional, Sequence, Tu
 from dynamic_load_balance_distributeddnn_tpu.analysis.guards import (
     AOT_THREAD_PREFIX,
 )
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
 
 
 def default_pool_size() -> int:
@@ -197,10 +198,18 @@ class AOTCompileService:
 
     def _compile_job(self, key: Hashable, fn, args: Sequence):
         t0 = time.perf_counter()
+        # graftscope compile track: lower vs backend-compile spans, tagged
+        # by pool thread (thread name) and dedup key — the view the PR-3
+        # compile-worker-contention question needs. The key is stringified
+        # lazily only when tracing is on (span args stay JSON-safe).
+        tr = get_tracer()
+        key_args = {"key": repr(key)} if tr.enabled else None
         try:
             with self._lower_lock:
-                lowered = fn.lower(*args)
-            compiled = lowered.compile()
+                with tr.span("aot_lower", cat="compile", args=key_args):
+                    lowered = fn.lower(*args)
+            with tr.span("aot_compile", cat="compile", args=key_args):
+                compiled = lowered.compile()
         except BaseException:
             with self._lock:
                 self._stats["failed"] += 1

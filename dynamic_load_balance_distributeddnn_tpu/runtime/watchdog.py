@@ -25,11 +25,18 @@ import sys
 import threading
 import time
 
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
+
 _ENV = "DBS_HEARTBEAT_FILE"
 
 
 def heartbeat() -> None:
-    """Touch the heartbeat file, if one is configured."""
+    """Touch the heartbeat file, if one is configured. With graftscope
+    tracing on, each heartbeat additionally lands as an instant event in the
+    trace — the device-answered pulse train, visible between spans."""
+    tracer = get_tracer()
+    if tracer.enabled:
+        tracer.instant("heartbeat", cat="heartbeat")
     path = os.environ.get(_ENV)
     if not path:
         return

@@ -25,6 +25,7 @@ enters the solver's time vector — the reference's compute/comm split contract
 from __future__ import annotations
 
 import concurrent.futures
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -57,7 +58,12 @@ from dynamic_load_balance_distributeddnn_tpu.faults import (
     StaticStragglerInjector,
 )
 from dynamic_load_balance_distributeddnn_tpu.models import build_model
-from dynamic_load_balance_distributeddnn_tpu.obs import MetricsRecorder, init_logger
+from dynamic_load_balance_distributeddnn_tpu.obs import (
+    MetricsRecorder,
+    MetricsRegistry,
+    init_logger,
+)
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import EPOCH_CAT, get_tracer
 from dynamic_load_balance_distributeddnn_tpu.ops.faultload import calibrate_iter_cost
 from dynamic_load_balance_distributeddnn_tpu.ops.losses import example_weights
 from dynamic_load_balance_distributeddnn_tpu.parallel import WorkerTopology, data_mesh
@@ -282,6 +288,29 @@ class Trainer:
         # warning is cross-checked against (run_epoch).
         self._host_meter = HostOverheadMeter()
         self._superstep_keys: set = set()
+        # graftscope (obs/trace.py + obs/registry.py): the process-wide span
+        # tracer — configured here from the run config, shared by every
+        # instrumented module (pipeline, AOT service, solver, watchdog) —
+        # and the unified registry over this engine's observability
+        # surfaces. trace="off" keeps every span call a single attribute
+        # check (no buffer, no jax — sentinel-silent under the compile
+        # guards); the trace saves at end of run (run()).
+        # The engine OWNS the process-wide tracer config: configure
+        # unconditionally, so a trace="off" run can never inherit an earlier
+        # traced run's enabled state (and its wall overhead + surprise
+        # trace file) from the same process — bench arms, test suites and
+        # notebook drivers all build engines back to back.
+        self._trace = get_tracer().configure(
+            cfg.trace,
+            ring_size=cfg.trace_ring,
+            jax_annotations=cfg.trace_annotations,
+        )
+        self.obs = MetricsRegistry(recorder=self.recorder, tracer=self._trace)
+        self.obs.attach(
+            host_meter=self._host_meter, compile_tracker=self._compile_tracker
+        )
+        if self._aot is not None:
+            self.obs.attach(aot_service=self._aot)
         if cfg.packed == "on":
             # fail fast at init: the epoch dispatch prefers the fused paths,
             # so a forced-but-infeasible packed config would otherwise be
@@ -953,6 +982,7 @@ class Trainer:
         if self.proc_id == 0:
             # rank-0-only artifact, like the reference (dbs.py:440-442)
             self.recorder.save(cfg.stat_dir, cfg.base_filename())
+        self.save_trace()
         self.logger.info(
             f"Total wallclock: {self.total_wallclock:.3f}s"
             + (
@@ -962,6 +992,25 @@ class Trainer:
             )
         )
         return self.recorder
+
+    def save_trace(self) -> Optional[str]:
+        """Persist the graftscope trace (Chrome-trace JSON under
+        cfg.trace_dir, config-encoded filename per process) when tracing is
+        enabled; returns the path. Summarize with `graftscope summarize`,
+        or open in ui.perfetto.dev next to a --profile_dir device trace."""
+        if not self._trace.enabled:
+            return None
+        path = os.path.join(
+            self.cfg.trace_dir,
+            self.cfg.base_filename().format(self.proc_id) + ".trace.json",
+        )
+        self._trace.save(path)
+        self.logger.info(
+            f"graftscope trace saved: {path} "
+            f"({len(self._trace.events())} events; `graftscope summarize` "
+            "for the per-phase epoch-attribution table)"
+        )
+        return path
 
     def _save_checkpoint(self, epoch: int) -> None:
         from dynamic_load_balance_distributeddnn_tpu.train.checkpoint import (
@@ -1004,14 +1053,30 @@ class Trainer:
     def _maybe_warm(self) -> None:
         if self.cfg.warm_start and not self._warmed:
             self._warmed = True
-            if self._aot is not None:
-                self._submit_warm_aot()  # non-blocking; compiles overlap epoch 0
-            else:
-                self._warm_shapes()
+            with self._trace.span("warm", cat="warm"):
+                if self._aot is not None:
+                    self._submit_warm_aot()  # non-blocking; compiles overlap epoch 0
+                else:
+                    self._warm_shapes()
 
     def run_epoch(self, epoch: int) -> Dict[str, float]:
+        """One epoch, wrapped in the graftscope epoch span: every event
+        emitted inside (phases here, transfer/dispatch/compile spans on
+        worker threads) is stamped with this epoch index, which is what the
+        offline attribution (`graftscope summarize`) groups by."""
+        tr = self._trace
+        tr.set_epoch(epoch)
+        try:
+            with tr.span("epoch", cat=EPOCH_CAT):
+                return self._run_epoch(epoch)
+        finally:
+            tr.set_epoch(None)
+
+    def _plan_epoch(self, epoch: int):
+        """The epoch's host-side control work — LR schedule, solver
+        rebalance, plan build, fault-episode setup, probe scheduling —
+        graftscope's ``plan_solve`` phase. Returns ``(plan, faults)``."""
         cfg = self.cfg
-        self._maybe_warm()  # callers driving epochs directly still warm first
         lr = one_cycle_lr(
             cfg.learning_rate,
             epoch,
@@ -1057,6 +1122,54 @@ class Trainer:
         )
         faults = self.injector.epoch_faults(epoch, plan.num_steps, ctx)
         self._probe_this_epoch = self._should_probe(epoch, plan, faults)
+        return plan, faults
+
+    def _dispatch_epoch(self, plan, faults: EpochFaults, epoch: int):
+        """Path selection + the epoch's whole timed training region —
+        graftscope's ``train`` phase. Returns ``(train_metrics,
+        ran_elastic)``."""
+        cfg = self.cfg
+        if (
+            cfg.shard_update or cfg.grad_accum > 1 or cfg.compress_grads
+        ) and not (self._can_use_fused(plan) or self._can_use_fused_dbs(plan)):
+            raise RuntimeError(
+                "shard_update/grad_accum/compress_grads require a fused path "
+                "(one worker per device); this plan fell back to the elastic "
+                "path"
+            )
+        if self._can_use_fused(plan):
+            return self._train_epoch_fused(plan, faults, epoch), False
+        if self._can_use_fused_dbs(plan):
+            return self._train_epoch_fused(plan, faults, epoch, dbs_probe=True), False
+        if self._can_use_packed(plan):
+            # probes still needed for the balancer signal and/or compute-mode
+            # injection calibration — mirrors the elastic path's condition
+            return (
+                self._train_epoch_fused(
+                    plan,
+                    faults,
+                    epoch,
+                    dbs_probe=(
+                        cfg.dynamic_batch_size
+                        or self._needs_iter_cost
+                        or self.timing_model is not None
+                    ),
+                    packed=True,
+                ),
+                False,
+            )
+        return self._train_epoch_elastic(plan, faults, epoch), True
+
+    def _run_epoch(self, epoch: int) -> Dict[str, float]:
+        tr = self._trace
+        self._maybe_warm()  # callers driving epochs directly still warm first
+        # Phase taxonomy (graftscope): plan_solve -> aot_drain -> train ->
+        # speculate -> validate -> record. The phases tile this method, so
+        # the trace attributes the epoch span's wall to named segments
+        # (`graftscope summarize` renders the table; the bench asserts
+        # >= 95% coverage on the CPU tier).
+        with tr.span("plan_solve"):
+            plan, faults = self._plan_epoch(epoch)
 
         # Drain pending AOT jobs (the warm universe's tail, the previous
         # epoch's speculation) BEFORE the timed region: concurrent backend
@@ -1069,39 +1182,12 @@ class Trainer:
         # up to here — plan build, rebalance, fault setup — and speculative
         # jobs still overlap the epoch that submits them.
         if self._aot is not None and self._aot.pending():
-            self._aot_wait_needed(tuple(self._aot.keys()), epoch)
+            with tr.span("aot_drain"):
+                self._aot_wait_needed(tuple(self._aot.keys()), epoch)
 
-        ran_elastic = False
         t_epoch = time.perf_counter()
-        if (
-            cfg.shard_update or cfg.grad_accum > 1 or cfg.compress_grads
-        ) and not (self._can_use_fused(plan) or self._can_use_fused_dbs(plan)):
-            raise RuntimeError(
-                "shard_update/grad_accum/compress_grads require a fused path "
-                "(one worker per device); this plan fell back to the elastic "
-                "path"
-            )
-        if self._can_use_fused(plan):
-            train_metrics = self._train_epoch_fused(plan, faults, epoch)
-        elif self._can_use_fused_dbs(plan):
-            train_metrics = self._train_epoch_fused(plan, faults, epoch, dbs_probe=True)
-        elif self._can_use_packed(plan):
-            # probes still needed for the balancer signal and/or compute-mode
-            # injection calibration — mirrors the elastic path's condition
-            train_metrics = self._train_epoch_fused(
-                plan,
-                faults,
-                epoch,
-                dbs_probe=(
-                    cfg.dynamic_batch_size
-                    or self._needs_iter_cost
-                    or self.timing_model is not None
-                ),
-                packed=True,
-            )
-        else:
-            train_metrics = self._train_epoch_elastic(plan, faults, epoch)
-            ran_elastic = True
+        with tr.span("train"):
+            train_metrics, ran_elastic = self._dispatch_epoch(plan, faults, epoch)
         # The wall excludes probe/instrumentation cost on EVERY path: the
         # fused path already kept its probes out (probe_overhead); the
         # elastic path's standalone worker probes (dbs_probe_cost) were
@@ -1122,10 +1208,32 @@ class Trainer:
         # speculative adjacent-rung compiles ride the UNTIMED tail: they
         # overlap validation below and drain before the next timed region
         if ran_elastic:
-            self._maybe_speculate(plan)
+            with tr.span("speculate"):
+                self._maybe_speculate(plan)
 
-        val_loss, accuracy = self.validate()
+        with tr.span("validate"):
+            val_loss, accuracy = self.validate()
 
+        with tr.span("record"):
+            self._record_epoch(
+                epoch, plan, faults, train_metrics, epoch_wall, probe_s,
+                val_loss, accuracy,
+            )
+        return {
+            "epoch_wall": epoch_wall,
+            "loss": train_metrics["loss"],
+            "val_loss": val_loss,
+            "accuracy": accuracy,
+        }
+
+    def _record_epoch(
+        self, epoch: int, plan, faults: EpochFaults, train_metrics,
+        epoch_wall: float, probe_s: float, val_loss: float, accuracy: float,
+    ) -> None:
+        """Post-epoch bookkeeping — modeled times, the probe schedule, the
+        cross-host time exchange, recorder extras and the recompile
+        sentinel — graftscope's ``record`` phase."""
+        cfg = self.cfg
         if (
             not self._probe_this_epoch
             and self.timing_model is None
@@ -1245,12 +1353,6 @@ class Trainer:
             wallclock_time=self.total_wallclock,
             **extras,
         )
-        return {
-            "epoch_wall": epoch_wall,
-            "loss": train_metrics["loss"],
-            "val_loss": val_loss,
-            "accuracy": accuracy,
-        }
 
     # ------------------------------------------------------ probe scheduling
 
@@ -1671,27 +1773,31 @@ class Trainer:
                 pack_total,
             )
             for i, _ in enumerate(ranges):
-                win = self._put_fused_window(*fut.result())
+                # transfer vs dispatch tracks in the trace: the put span
+                # includes any wait on the overlapped gather thread
+                with self._trace.span("fused_put", cat="transfer"):
+                    win = self._put_fused_window(*fut.result())
                 if i + 1 < len(ranges):
                     fut = pool.submit(
                         self._gather_fused_window, plan, *ranges[i + 1], pad_to,
                         use_cache, pack_total,
                     )
-                if use_cache:
-                    idxs, ws_ = win
-                    self.state, metrics = self.steps.fused_epoch_idx(
-                        self.state, cache_x, cache_y, idxs, ws_, slow, seed
-                    )
-                else:
-                    xs, ys, ws_ = win
-                    if first_window is None and self._fused_sync_per_step is None:
-                        # retained only on the run's first epoch, for the
-                        # one-time sync/FLOPs probes below — not pinned later
-                        first_window = (xs, ys, ws_)
-                    self.state, metrics = self.steps.fused_epoch(
-                        self.state, xs, ys, ws_, slow, seed
-                    )
-                metrics_total += np.asarray(jax.block_until_ready(metrics))
+                with self._trace.span("fused_dispatch", cat="dispatch"):
+                    if use_cache:
+                        idxs, ws_ = win
+                        self.state, metrics = self.steps.fused_epoch_idx(
+                            self.state, cache_x, cache_y, idxs, ws_, slow, seed
+                        )
+                    else:
+                        xs, ys, ws_ = win
+                        if first_window is None and self._fused_sync_per_step is None:
+                            # retained only on the run's first epoch, for the
+                            # one-time sync/FLOPs probes below — not pinned later
+                            first_window = (xs, ys, ws_)
+                        self.state, metrics = self.steps.fused_epoch(
+                            self.state, xs, ys, ws_, slow, seed
+                        )
+                    metrics_total += np.asarray(jax.block_until_ready(metrics))
                 heartbeat()
         metrics = metrics_total
         probe_overhead = 0.0
@@ -1706,9 +1812,10 @@ class Trainer:
                     )
                 )
             xs, ys, ws_ = first_window
-            self._fused_sync_per_step = self._probe_fused_sync(
-                xs, ys, ws_, slow, jnp.int32(cfg.seed * 31 + epoch)
-            )
+            with self._trace.span("sync_probe", cat="probe"):
+                self._fused_sync_per_step = self._probe_fused_sync(
+                    xs, ys, ws_, slow, jnp.int32(cfg.seed * 31 + epoch)
+                )
             if self._flops_per_padded_example is None:
                 from dynamic_load_balance_distributeddnn_tpu.obs.flops import (
                     compiled_flops,
@@ -1758,7 +1865,8 @@ class Trainer:
                     )
                     for r in range(self.ws_local)
                 ]
-                self._probe_workers(plan, data, faults, epoch)
+                with self._trace.span("probe", cat="probe"):
+                    self._probe_workers(plan, data, faults, epoch)
                 self._probes_ran = True
             if self.timing_model is not None:
                 modeled = np.asarray(self.timing_model(plan), dtype=np.float64)
@@ -2084,22 +2192,25 @@ class Trainer:
                 data, staged = pipe.get(i)
                 if first_data is None:
                     first_data = data
-                if mode == "scan":
-                    d0 = dev_order[0]
-                    win_key = topo.group_shape_key(
-                        [plan.workers[self.rank_lo + r].padded_batch
-                         for r in groups[d0]],
-                        w1 - w0,
-                    )
-                    self._dispatch_superstep_window(
-                        staged[d0], d0, groups[d0], win_key, slow_dev,
-                        aux_windows,
-                    )
-                else:
-                    self._dispatch_combine_steps(
-                        staged, w1 - w0, slow_dev, aux_acc,
-                        windowed=(mode == "window"),
-                    )
+                # one span per window (not per step): the dispatch track in
+                # the trace shows window boundaries without per-step cost
+                with self._trace.span("dispatch_window", cat="dispatch"):
+                    if mode == "scan":
+                        d0 = dev_order[0]
+                        win_key = topo.group_shape_key(
+                            [plan.workers[self.rank_lo + r].padded_batch
+                             for r in groups[d0]],
+                            w1 - w0,
+                        )
+                        self._dispatch_superstep_window(
+                            staged[d0], d0, groups[d0], win_key, slow_dev,
+                            aux_windows,
+                        )
+                    else:
+                        self._dispatch_combine_steps(
+                            staged, w1 - w0, slow_dev, aux_acc,
+                            windowed=(mode == "window"),
+                        )
         if mode == "scan":
             # flatten the scanned aux back into the per-step path's exact
             # (step, worker) row order so the float64 metric summation below
@@ -2130,7 +2241,8 @@ class Trainer:
             and (cfg.dynamic_batch_size or self._needs_iter_cost)
         ):
             t0p = time.perf_counter()
-            sync_probe = self._probe_workers(plan, data, faults, epoch)
+            with self._trace.span("probe", cat="probe"):
+                sync_probe = self._probe_workers(plan, data, faults, epoch)
             dbs_probe_cost = time.perf_counter() - t0p
             self._sync_per_step = sync_probe
             # Replicated-state flag: everyone probes epoch 0 (pure config +
@@ -2293,7 +2405,11 @@ class Trainer:
                     e_read = min(e_read, time.perf_counter() - t0)
                 ovh_by_dev[d] = min(e_block, e_read)
             self._probe_overhead_s = max(ovh_by_dev.values())
-            self.recorder.meta["probe_dispatch_overhead_s"] = round(
+            # sanctioned bare wall: the dispatch-overhead estimate IS a raw
+            # min-over-reps perf_counter pair by construction (a span cannot
+            # express the paired-min discipline), and it is provenance
+            # metadata, not a timed phase
+            self.recorder.meta["probe_dispatch_overhead_s"] = round(  # graftlint: disable=G008
                 self._probe_overhead_s, 6
             )
 

@@ -170,7 +170,12 @@ class LMTrainer(Trainer):
             self._win_cache = {}
         key = (rank, pad_to)
         if key not in self._win_cache:
-            self._win_cache[key] = self._build_windows(plan, rank, pad_to)
+            # graftscope: the LM's host data plane — token-window folds are
+            # built once per (epoch, rank, pad) and show as their own spans
+            with self._trace.span(
+                "lm_build_windows", cat="transfer", args={"rank": rank}
+            ):
+                self._win_cache[key] = self._build_windows(plan, rank, pad_to)
         x, y, weights = self._win_cache[key]
         if s1 is None:
             s1 = plan.num_steps

@@ -25,6 +25,8 @@ import concurrent.futures
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
+
 
 class WindowTransferPipeline:
     """Double-buffered (gather → per-device put) pipeline over step windows.
@@ -58,15 +60,22 @@ class WindowTransferPipeline:
     def _stage_device(self, d: int, i: int, gather_fut) -> object:
         data = gather_fut.result()
         t0 = time.perf_counter()
-        staged = self._stage(d, i, data)
+        # graftscope transfer track: staging threads are named, so each
+        # device's puts appear on their own timeline row in Perfetto
+        with get_tracer().span("stage", cat="transfer", args={"window": i, "device": d}):
+            staged = self._stage(d, i, data)
         if self._meter is not None:
             self._meter.add_put_s(time.perf_counter() - t0)
         return staged
 
+    def _gather_window(self, i: int):
+        with get_tracer().span("gather", cat="transfer", args={"window": i}):
+            return self._gather(*self._ranges[i])
+
     def _launch(self, i: int) -> None:
         if i in self._inflight or not (0 <= i < len(self._ranges)):
             return
-        gather_fut = self._pool.submit(self._gather, *self._ranges[i])
+        gather_fut = self._pool.submit(self._gather_window, i)
         put_futs = {
             d: self._pool.submit(self._stage_device, d, i, gather_fut)
             for d in self._devices

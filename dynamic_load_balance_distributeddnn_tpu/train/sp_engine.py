@@ -16,6 +16,7 @@ between trainers.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional, Tuple
 
@@ -31,7 +32,12 @@ from dynamic_load_balance_distributeddnn_tpu.data.corpus import (
     bptt_windows,
 )
 from dynamic_load_balance_distributeddnn_tpu.models import build_model
-from dynamic_load_balance_distributeddnn_tpu.obs import MetricsRecorder, init_logger
+from dynamic_load_balance_distributeddnn_tpu.obs import (
+    MetricsRecorder,
+    MetricsRegistry,
+    init_logger,
+)
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import EPOCH_CAT, get_tracer
 from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import data_mesh, replicated_sharding
 from dynamic_load_balance_distributeddnn_tpu.parallel.seq_parallel import (
     make_seq_parallel_apply,
@@ -119,6 +125,15 @@ class SeqParallelLMTrainer:
 
         self._update = update
         self.recorder = MetricsRecorder()
+        # graftscope: the engine owns the process-wide tracer config (same
+        # contract as the DBS engines — unconditional, so an off run never
+        # inherits an earlier traced run's enabled state) + the registry
+        self._trace = get_tracer().configure(
+            cfg.trace,
+            ring_size=cfg.trace_ring,
+            jax_annotations=cfg.trace_annotations,
+        )
+        self.obs = MetricsRegistry(recorder=self.recorder, tracer=self._trace)
         self.recorder.stamp_data_source(self.corpus)
         # SP walls never contained standalone probe steps (the SP engine has
         # no re-probe machinery); stamped so its artifacts carry the same
@@ -139,51 +154,65 @@ class SeqParallelLMTrainer:
         return bptt_windows(data, self.cfg.bptt)
 
     def run_epoch(self, epoch: int) -> dict:
+        tr = get_tracer()
+        tr.set_epoch(epoch)
+        try:
+            with tr.span("epoch", cat=EPOCH_CAT):
+                return self._run_epoch(epoch)
+        finally:
+            tr.set_epoch(None)
+
+    def _run_epoch(self, epoch: int) -> dict:
         cfg = self.cfg
-        if cfg.one_cycle_policy:
-            lr = one_cycle_lr(cfg.learning_rate, epoch, cfg.epoch_size,
-                              disable=cfg.disable_enhancements)
-            self.state = self.state.with_learning_rate(lr)
-        xs, ys, ms = self._windows(self.data)
-        t0 = time.perf_counter()
-        loss_sum, tok, n_done = 0.0, 0, 0
-        for s in range(xs.shape[0]):
-            # full-length windows only: the SP shard_map needs T % n_dev == 0
-            if not ms[s].all():
-                continue
-            x = shard_tokens(self.mesh, jnp.asarray(xs[s], jnp.int32))
-            y = shard_tokens(self.mesh, jnp.asarray(ys[s], jnp.int32))
-            loss, grads = self._vg(
-                self.state.params, x, y,
-                jax.random.fold_in(jax.random.PRNGKey(cfg.seed), epoch * 131071 + s),
-            )
-            self.state = self._update(self.state, grads)
-            loss_sum += float(loss)
-            tok += int(ms[s].sum())
-            n_done += 1
-        jax.block_until_ready(self.state.params)
-        wall = time.perf_counter() - t0
+        tr = get_tracer()
+        with tr.span("plan_solve"):
+            if cfg.one_cycle_policy:
+                lr = one_cycle_lr(cfg.learning_rate, epoch, cfg.epoch_size,
+                                  disable=cfg.disable_enhancements)
+                self.state = self.state.with_learning_rate(lr)
+            xs, ys, ms = self._windows(self.data)
+        with tr.span("train"):
+            t0 = time.perf_counter()
+            loss_sum, tok, n_done = 0.0, 0, 0
+            for s in range(xs.shape[0]):
+                # full-length windows only: the SP shard_map needs T % n_dev == 0
+                if not ms[s].all():
+                    continue
+                x = shard_tokens(self.mesh, jnp.asarray(xs[s], jnp.int32))
+                y = shard_tokens(self.mesh, jnp.asarray(ys[s], jnp.int32))
+                loss, grads = self._vg(
+                    self.state.params, x, y,
+                    jax.random.fold_in(jax.random.PRNGKey(cfg.seed), epoch * 131071 + s),
+                )
+                self.state = self._update(self.state, grads)
+                loss_sum += float(loss)
+                tok += int(ms[s].sum())
+                n_done += 1
+            jax.block_until_ready(self.state.params)
+            wall = time.perf_counter() - t0
         self.total_wallclock += wall
         train_loss = loss_sum / max(n_done, 1)
-        val_loss, acc = self.validate()
-        tps = tok / wall if wall > 0 else 0.0
-        self.logger.info(
-            f"Epoch {epoch}: sp={cfg.seq_parallel} T={cfg.bptt} "
-            f"train_loss {train_loss:.4f}, val_loss {val_loss:.4f}, "
-            f"{tps:,.0f} tok/s, wall {wall:.3f}s"
-        )
-        self.recorder.record_epoch(
-            epoch=epoch,
-            train_loss=train_loss,
-            train_time=wall,
-            sync_time=0.0,
-            val_loss=val_loss,
-            accuracy=acc,
-            partition=[1.0 / self.n_dev] * self.n_dev,
-            node_time=[wall] * self.n_dev,
-            wallclock_time=self.total_wallclock,
-            tokens_per_s=tps,
-        )
+        with tr.span("validate"):
+            val_loss, acc = self.validate()
+        with tr.span("record"):
+            tps = tok / wall if wall > 0 else 0.0
+            self.logger.info(
+                f"Epoch {epoch}: sp={cfg.seq_parallel} T={cfg.bptt} "
+                f"train_loss {train_loss:.4f}, val_loss {val_loss:.4f}, "
+                f"{tps:,.0f} tok/s, wall {wall:.3f}s"
+            )
+            self.recorder.record_epoch(
+                epoch=epoch,
+                train_loss=train_loss,
+                train_time=wall,
+                sync_time=0.0,
+                val_loss=val_loss,
+                accuracy=acc,
+                partition=[1.0 / self.n_dev] * self.n_dev,
+                node_time=[wall] * self.n_dev,
+                wallclock_time=self.total_wallclock,
+                tokens_per_s=tps,
+            )
         return {"epoch_wall": wall, "loss": train_loss, "val_loss": val_loss}
 
     def validate(self) -> Tuple[float, float]:
@@ -209,4 +238,11 @@ class SeqParallelLMTrainer:
             self.run_epoch(e)
         self.logger.info(f"Total wallclock: {self.total_wallclock:.3f}s")
         self.recorder.save(self.cfg.stat_dir, self.cfg.base_filename())
+        if self._trace.enabled:
+            path = os.path.join(
+                self.cfg.trace_dir,
+                self.cfg.base_filename().format(0) + ".trace.json",
+            )
+            self._trace.save(path)
+            self.logger.info(f"graftscope trace saved: {path}")
         return self.recorder

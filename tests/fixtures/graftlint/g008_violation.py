@@ -1,0 +1,27 @@
+"""G008 seed: a bare wall-clock delta recorded as a metric.
+
+The pre-graftscope engine idiom: an epoch wall measured with a raw
+``perf_counter()`` pair lands directly in the recorder's series — so the
+trace cannot attribute it (it lives outside every span) and ``graftscope
+diff`` can never explain a regression in it. The sanctioned forms measure
+under a span (the wall then IS a trace event) or aggregate through
+TimeKeeper/HostOverheadMeter.
+"""
+
+import time
+
+
+def run_epoch(recorder, dispatch, epoch):
+    t0 = time.perf_counter()
+    dispatch()
+    wall = time.perf_counter() - t0
+    recorder.record_epoch(epoch=epoch, train_time=wall)
+    return wall
+
+
+def run_epoch_meta(recorder, dispatch):
+    t0 = time.perf_counter()
+    dispatch()
+    overhead = min(0.5, time.perf_counter() - t0)
+    recorder.meta["dispatch_overhead_s"] = round(overhead, 6)
+    return overhead

@@ -36,6 +36,7 @@ def codes(findings):
         ("g005_violation.py", "G005", 1),
         ("g006_violation.py", "G006", 1),
         ("g007_violation.py", "G007", 2),  # execute-warm loop + timed compile
+        ("g008_violation.py", "G008", 2),  # recorded series + meta write
     ],
 )
 def test_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -156,6 +157,47 @@ def test_g007_compile_outside_timed_window_is_quiet():
         "    return step.lower(spec).compile()\n"
     )
     assert lint_source(src) == []
+
+
+def test_g008_span_covered_wall_is_quiet():
+    """The sanctioned bare-wall form: a delta measured inside a graftscope
+    span block is already attributable in the trace, so recording it is
+    fine; TimeKeeper aggregation likewise never reaches the sink raw."""
+    covered = (
+        "import time\n"
+        "def run_epoch(tracer, recorder, dispatch, epoch):\n"
+        "    with tracer.span('train'):\n"
+        "        t0 = time.perf_counter()\n"
+        "        dispatch()\n"
+        "        wall = time.perf_counter() - t0\n"
+        "    recorder.record_epoch(epoch=epoch, train_time=wall)\n"
+    )
+    assert lint_source(covered) == []
+    # a wall feeding only TimeKeeper (not the recorder) is the other
+    # sanctioned channel — no recorder sink, no finding
+    timekeeper = (
+        "import time\n"
+        "def probe(timekeeper, dispatch, rank):\n"
+        "    t0 = time.perf_counter()\n"
+        "    dispatch()\n"
+        "    dt = time.perf_counter() - t0\n"
+        "    timekeeper.add_compute(rank, dt)\n"
+    )
+    assert lint_source(timekeeper) == []
+
+
+def test_g008_transitive_flow_through_extras_dict_trips():
+    src = (
+        "import time\n"
+        "def run_epoch(recorder, dispatch, n):\n"
+        "    t0 = time.perf_counter()\n"
+        "    dispatch()\n"
+        "    wall = time.perf_counter() - t0\n"
+        "    extras = {}\n"
+        "    extras['examples_per_s'] = n / wall\n"
+        "    recorder.record_epoch(epoch=0, **extras)\n"
+    )
+    assert codes(lint_source(src)) == {"G008"}
 
 
 # ------------------------------------------------------------ rule mechanics

@@ -1,0 +1,133 @@
+"""MetricsRecorder round-trip (.npy dict + JSON sidecar + _meta), the
+last()-returns-None contract, and the run-log resume/append behavior."""
+
+import json
+import os
+
+import numpy as np
+
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.obs.logging import init_logger
+from dynamic_load_balance_distributeddnn_tpu.obs.recorder import SERIES, MetricsRecorder
+
+
+def _filled_recorder(epochs=2, ws=4):
+    rec = MetricsRecorder()
+    rec.meta["synthetic"] = True
+    rec.meta["straggler_factors"] = [3.0, 1.0, 1.0, 1.0]
+    for e in range(epochs):
+        rec.record_epoch(
+            epoch=e,
+            train_loss=2.0 - 0.1 * e,
+            train_time=1.5 + e,
+            sync_time=0.05,
+            val_loss=2.1 - 0.1 * e,
+            accuracy=10.0 * (e + 1),
+            partition=[1.0 / ws] * ws,
+            node_time=[1.0 + 0.1 * r for r in range(ws)],
+            wallclock_time=3.0 * (e + 1),
+            # extra (optional) series ride alongside the reference nine
+            examples_per_s=100.0 + e,
+            xla_compiles=float(e),
+        )
+    return rec
+
+
+def test_last_returns_none_for_absent_and_empty_series():
+    rec = MetricsRecorder()
+    # the satellite bug: an optional series never recorded used to KeyError
+    assert rec.last("examples_per_s") is None
+    assert rec.last("epoch") is None  # declared but empty
+    rec = _filled_recorder()
+    assert rec.last("examples_per_s") == 101.0
+    assert rec.last("mfu_bf16_peak") is None
+
+
+def test_roundtrip_npy_and_json_sidecar(tmp_path):
+    rec = _filled_recorder()
+    npy_path = rec.save(str(tmp_path), "run-node{}", rank=0)
+    assert npy_path.endswith(".npy") and os.path.exists(npy_path)
+
+    # the .npy payload is the reference-parity pickled dict
+    raw = np.load(npy_path, allow_pickle=True).item()
+    assert set(SERIES) <= set(raw)
+    assert "_meta" not in raw  # meta lives only in the sidecar
+
+    # JSON sidecar: all series + _meta
+    with open(npy_path[:-4] + ".json") as f:
+        sidecar = json.load(f)
+    assert sidecar["_meta"]["synthetic"] is True
+    assert sidecar["examples_per_s"] == [100.0, 101.0]
+
+    # load() round-trips data AND meta, from the .npy path or the bare stem
+    for src in (npy_path, npy_path[:-4]):
+        loaded = MetricsRecorder.load(src)
+        assert loaded.data == rec.data
+        assert loaded.meta == {
+            "synthetic": True,
+            "straggler_factors": [3.0, 1.0, 1.0, 1.0],
+        }
+        assert loaded.last("examples_per_s") == 101.0
+        assert loaded.last("never_recorded") is None
+
+
+def test_roundtrip_without_sidecar_keeps_data(tmp_path):
+    rec = _filled_recorder(epochs=1)
+    npy_path = rec.save(str(tmp_path), "run-node{}")
+    os.unlink(npy_path[:-4] + ".json")
+    loaded = MetricsRecorder.load(npy_path)
+    assert loaded.data == rec.data
+    assert loaded.meta == {}
+
+
+# -------------------------------------------------------------- run logging
+
+
+def _log_path(cfg):
+    return os.path.join(cfg.log_dir, cfg.base_filename().format(0) + ".log")
+
+
+def test_fresh_run_truncates_and_tags_start(tmp_path):
+    cfg = Config(log_dir=str(tmp_path))
+    logger = init_logger(cfg)
+    logger.info("line one")
+    text = open(_log_path(cfg)).read()
+    assert "run started" in text.splitlines()[0]
+    # a re-run of the same non-checkpointed config is a FRESH run: truncate
+    init_logger(cfg)
+    text = open(_log_path(cfg)).read()
+    assert "line one" not in text
+    assert text.count("run started") == 1
+
+
+def test_ckpt_dir_without_checkpoint_is_still_a_fresh_run(tmp_path):
+    # ckpt_dir set but no checkpoint ever saved (dir absent/empty): a re-run
+    # is FRESH — truncate, don't append onto a dead run's log
+    cfg = Config(log_dir=str(tmp_path / "logs"), ckpt_dir=str(tmp_path / "ckpt"))
+    init_logger(cfg).info("first attempt")
+    (tmp_path / "ckpt").mkdir()  # exists but empty = still no checkpoint
+    init_logger(cfg)
+    text = open(_log_path(cfg)).read()
+    assert "first attempt" not in text
+    assert "run resumed" not in text
+
+
+def test_checkpoint_resume_appends_and_tags_each_restart(tmp_path):
+    cfg = Config(log_dir=str(tmp_path / "logs"), ckpt_dir=str(tmp_path / "ckpt"))
+    logger = init_logger(cfg)
+    logger.info("pre-crash history")
+    # a checkpoint landed (non-empty ckpt_dir — the restore condition), so
+    # the second init is a resume: history survives, the boundary is tagged
+    (tmp_path / "ckpt").mkdir()
+    (tmp_path / "ckpt" / "0").mkdir()
+    logger = init_logger(cfg)
+    logger.info("post-resume line")
+    lines = open(_log_path(cfg)).read().splitlines()
+    text = "\n".join(lines)
+    assert "pre-crash history" in text and "post-resume line" in text
+    assert "run started" in lines[0]
+    assert sum("run resumed" in ln for ln in lines) == 1
+    # the resume tag is the first line of the restart's segment
+    resume_idx = next(i for i, ln in enumerate(lines) if "run resumed" in ln)
+    assert any("pre-crash history" in ln for ln in lines[:resume_idx])
+    assert any("post-resume line" in ln for ln in lines[resume_idx + 1:])

@@ -26,6 +26,10 @@ init can block 50+ minutes and then fail UNAVAILABLE):
    AND every improvement (including the pre-preflight disk-derived seed) is
    printed as a JSON line the moment it exists, so even an unhandleable
    SIGKILL mid-ladder leaves the best-so-far as the final parsed line.
+   When NO prior artifact exists (rounds 4/5 both started cold and round 5
+   died at rc=124 with `parsed: null`), an explicit `{"status":"no_result"}`
+   floor line is printed before anything can eat the budget — the driver
+   always parses SOMETHING, and any later improvement supersedes the floor.
 5. AOT WARM A/B — the CPU tier also measures the serial execute-to-compile
    warm wall vs the concurrent AOT compile service (`aot_warm_ab` field,
    dedicated subprocess with per-program-serial codegen; ISSUE 3).
@@ -34,6 +38,11 @@ init can block 50+ minutes and then fail UNAVAILABLE):
    plan; the traced leg writes the Chrome-trace JSON and reports per-phase
    epoch attribution + worst-epoch coverage; ISSUE 4, BENCH_TRACE_AB=0
    disables).
+7. COMPILE WORKERS A/B — the CPU tier measures multi-program compile
+   throughput through the AOT service's process-worker backend vs the
+   in-process thread pool (`compile_workers_ab` field: the same eight
+   resnet18 worker-step programs, equal compile counts, thread leg first
+   on a disabled persistent cache; ISSUE 5, BENCH_WORKERS_AB=0 disables).
 
 Instrumentation: examples/s and MFU (obs/flops.py, XLA cost model vs chip
 bf16 peak) from the trainer's recorder extras, reported in `detail`.
@@ -93,9 +102,21 @@ def _install_init_watchdog():
     return done
 
 
-def run_preflight() -> int:
+def run_preflight(light: bool = False) -> int:
     """Init the backend, run one tiny matmul, report device info. rc 0 = the
-    TPU is usable; rc 17 = init watchdog fired; other rc = init raised."""
+    TPU is usable; rc 17 = init watchdog fired; other rc = init raised.
+
+    ``light`` is attempt 1's shrunk profile (rounds 4/5 died rc=124 with the
+    ladder still inside attempt 1): the init watchdog is capped INSIDE the
+    attempt's own 600 s budget — the default 2700 s watchdog meant a wedged
+    init could only be ended by the parent's kill, eating the whole cap —
+    and the matmul compile is skipped (first contact with a cold persistent
+    cache + remote-compile tunnel is the slow path). A light pass proves the
+    runtime answers; the full pass on the next rung proves it computes."""
+    if light:
+        os.environ["BENCH_INIT_TIMEOUT"] = os.environ.get(
+            "BENCH_PREFLIGHT_LIGHT_INIT_S", "540"
+        )
     done = _install_init_watchdog()
     t0 = time.time()
     import jax
@@ -106,15 +127,17 @@ def run_preflight() -> int:
         sys.stderr.write(f"[preflight] init raised after {time.time()-t0:.0f}s: {e}\n")
         return 3
     done.set()
-    import jax.numpy as jnp
+    if not light:
+        import jax.numpy as jnp
 
-    y = (jnp.ones((256, 256), jnp.bfloat16) @ jnp.ones((256, 256), jnp.bfloat16))
-    jax.block_until_ready(y)
+        y = (jnp.ones((256, 256), jnp.bfloat16) @ jnp.ones((256, 256), jnp.bfloat16))
+        jax.block_until_ready(y)
     info = {
         "platform": ds[0].platform,
         "device_kind": getattr(ds[0], "device_kind", "?"),
         "n_devices": len(ds),
         "init_s": round(time.time() - t0, 1),
+        "light": light,
     }
     print(json.dumps(info), flush=True)
     return 0
@@ -541,6 +564,56 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
                 )
             out["instr"]["trace_overhead_ab"] = ab
         _write_atomic(out_path, out)
+
+    if (
+        force_cpu
+        and os.environ.get("BENCH_WORKERS_AB", "1") == "1"
+        and "compile_workers_ab" not in out["instr"]
+    ):
+        if resume.get("instr", {}).get("compile_workers_ab"):
+            out["instr"]["compile_workers_ab"] = resume["instr"]["compile_workers_ab"]
+        else:
+            # Process-worker vs in-process-thread compile throughput A/B
+            # (ISSUE 5 acceptance) in a dedicated subprocess: the thread leg
+            # needs the persistent cache force-DISABLED and the process leg
+            # repoints it at a fresh dir — neither can change in this
+            # process after its backend initialized.
+            fd, ab_path = tempfile.mkstemp(suffix=".json")
+            os.close(fd)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+            proc = None
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--workers-ab",
+                     "--out", ab_path],
+                    capture_output=True,
+                    text=True,
+                    timeout=float(os.environ.get("BENCH_WORKERS_AB_TIMEOUT", 1500)),
+                    env=env,
+                )
+                with open(ab_path) as f:
+                    ab = json.load(f)
+                # the child writes incrementally: only adopt a COMPLETE A/B
+                # (speedup present) or an explicit error marker
+                if proc.returncode == 0 and ("speedup_x" in ab or "error" in ab):
+                    out["instr"]["compile_workers_ab"] = ab
+                else:
+                    sys.stderr.write(
+                        f"[bench] compile_workers_ab incomplete "
+                        f"(rc={proc.returncode}, keys={sorted(ab)}); dropped\n"
+                    )
+            except Exception as e:
+                sys.stderr.write(f"[bench] compile_workers_ab failed: {e}\n")
+            finally:
+                if proc is not None and proc.returncode != 0 and proc.stderr:
+                    sys.stderr.write(proc.stderr[-800:] + "\n")
+                try:
+                    os.unlink(ab_path)
+                except OSError:
+                    pass
+        _write_atomic(out_path, out)
     return 0
 
 
@@ -635,6 +708,151 @@ def run_aot_ab(out_path: str) -> int:
                 - out["concurrent_aot"]["compile_events"]
             )
             <= 0.1 * out["serial_execute"]["compile_events"] + 2
+        )
+    _write_atomic(out_path, out)
+    return 0
+
+
+def run_workers_ab(out_path: str) -> int:
+    """Process-worker vs in-process-thread compile throughput A/B (the
+    ISSUE-5 ``compile_workers_ab`` field). The SAME eight mesh-placed
+    resnet18 worker-step programs (4 devices x 2 ladder rungs, the engine's
+    own AOT lowerables) are submitted through the AOTCompileService twice:
+    ``backend="thread"`` then ``backend="process"`` — equal compile counts
+    by construction, identical program set.
+
+    Fairness: the thread leg runs FIRST with the persistent compilation
+    cache force-disabled, so every job is a real backend compile. The
+    process leg then points the cache at a FRESH directory (the worker
+    channel; ``ensure_persistent_cache`` resets jax's memoized cache-used
+    decision) so its workers also compile every program for real — the
+    parent's replays landing as cache hits is the mechanism under test, not
+    a shortcut, and ``replay_cache_hits`` records it. A fresh Trainer per
+    leg keeps jit tracing caches from subsidizing leg 2. Worker spawn +
+    jax import (reported as ``worker_startup_s``) happens BEFORE the timed
+    window — in production it overlaps the run's own warm-up.
+
+    Interpretation: with compile work core-bound on this 2-core CI tier,
+    both legs saturate the same cores and the wall ratio hovers near 1x —
+    ``cores`` rides along so the ratio is read against the hardware. The
+    worker pool's scaling headroom (each worker owns an emitter + GIL)
+    shows when cores exceed the concurrent-program count; ROADMAP records
+    the many-core sizing follow-up."""
+    done = _install_init_watchdog()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.devices()
+    done.set()
+    # thread leg must pay real compiles: the bench-wide pinned cache (and
+    # any entries a previous round left in it) is off the table
+    jax.config.update("jax_enable_compilation_cache", False)
+
+    from dynamic_load_balance_distributeddnn_tpu.config import Config
+    from dynamic_load_balance_distributeddnn_tpu.data import load_dataset
+    from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+    rungs = (64, 128)
+    n_workers = int(os.environ.get("BENCH_WORKERS_AB_WORKERS", 4))
+    bundle = load_dataset("cifar10", n_train=1024, n_test=256)
+    out = {
+        "model": "resnet18",
+        "rungs": list(rungs),
+        "workers": n_workers,
+        "cores": os.cpu_count(),
+        "note": "equal compile counts (identical program set per leg); "
+        "thread leg first, persistent cache disabled for it; wall ratio is "
+        "core-bound on few-core hosts",
+    }
+    replay_hits = []
+    from jax._src import monitoring
+
+    monitoring.register_event_listener(
+        lambda name, **kw: replay_hits.append(name)
+        if name == "/jax/compilation_cache/cache_hits"
+        else None
+    )
+
+    def leg(backend):
+        cfg = Config(
+            debug=False,
+            world_size=4,
+            batch_size=256,
+            learning_rate=0.01,
+            epoch_size=1,
+            dataset="cifar10",
+            model="resnet18",
+            dynamic_batch_size=True,
+            bucket=64,
+            capacity_factor=2.0,
+            warm_start=False,
+            aot_warm=True,
+            aot_backend=backend,
+            aot_workers=n_workers,
+        )
+        tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+        svc = tr._aot
+        res = {}
+        if backend == "process":
+            pool = svc._ensure_worker_pool()
+            if pool is None:
+                return None, {"error": "worker pool unavailable"}
+            pool.wait_ready(
+                timeout=float(os.environ.get("BENCH_WORKERS_AB_SPAWN_S", 300)),
+                all_workers=True,
+            )
+            res["worker_startup_s"] = round(pool.startup_s or 0.0, 3)
+        t0 = time.perf_counter()
+        jobs = []
+        for d in tr.topology.used_device_indices:
+            for b in rungs:
+                jobs += tr._aot_submit_worker_steps(
+                    d, b, (), want_acc=False, want_plain=True
+                )
+        failures = svc.wait()
+        res["wall_s"] = round(time.perf_counter() - t0, 3)
+        st = svc.stats()
+        res["jobs"] = len(jobs)
+        res["compiled"] = int(st["compiled"])
+        if failures:
+            res["error"] = f"{len(failures)} compile jobs failed"
+        if backend == "process":
+            res["worker_compiled"] = int(st["worker_compiled"])
+            res["worker_fallback"] = int(st["worker_fallback"])
+        svc.close()
+        return res if "error" not in res else None, res
+
+    thread_res, raw = leg("thread")
+    out["thread"] = raw
+    _write_atomic(out_path, out)
+    if thread_res is None:
+        out["error"] = raw.get("error", "thread leg failed")
+        _write_atomic(out_path, out)
+        return 1
+
+    # the worker channel: a fresh cache dir (never the bench-wide pinned one
+    # — its prior-round entries would turn worker compiles into lookups and
+    # fake the throughput)
+    cache_dir = tempfile.mkdtemp(prefix="bench_workers_ab_cache_")
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_enable_compilation_cache", True)
+    replay_hits.clear()
+    proc_res, raw = leg("process")
+    raw["replay_cache_hits"] = len(replay_hits)
+    out["process"] = raw
+    if proc_res is None:
+        out["error"] = raw.get("error", "process leg failed")
+        _write_atomic(out_path, out)
+        return 1
+    out["equal_compile_counts"] = thread_res["compiled"] == proc_res["compiled"]
+    if proc_res["wall_s"] > 0:
+        out["speedup_x"] = round(thread_res["wall_s"] / proc_res["wall_s"], 3)
+        out["thread_programs_per_min"] = round(
+            60.0 * thread_res["compiled"] / thread_res["wall_s"], 2
+        )
+        out["process_programs_per_min"] = round(
+            60.0 * proc_res["compiled"] / proc_res["wall_s"], 2
         )
     _write_atomic(out_path, out)
     return 0
@@ -1104,9 +1322,11 @@ def _cached_tpu_result() -> dict | None:
 def main() -> int:
     global _best_result
     if "--preflight" in sys.argv:
-        return run_preflight()
+        return run_preflight(light="--light" in sys.argv)
     if "--aot-ab" in sys.argv:
         return run_aot_ab(sys.argv[sys.argv.index("--out") + 1])
+    if "--workers-ab" in sys.argv:
+        return run_workers_ab(sys.argv[sys.argv.index("--out") + 1])
     if "--arms" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
         resume = (
@@ -1144,6 +1364,22 @@ def main() -> int:
     if seeded is not None:
         _publish(seeded)
         sys.stderr.write(f"[bench] pre-captured fallback result ({seed_src})\n")
+    else:
+        # Cold start, nothing derivable from disk (rounds 4 and 5): the
+        # "every improvement prints a JSON line immediately" guarantee had
+        # no FIRST line to fall back on, so an rc=124 kill inside the
+        # preflight ladder left `parsed: null`. Emit an explicit floor NOW —
+        # the driver always parses something; any later result supersedes it
+        # as the new last line. Deliberately NOT stored in _best_result: the
+        # floor must not gate off the insurance arms or the cached-artifact
+        # fallbacks below, which all key on "no real result yet".
+        floor = {
+            "status": "no_result",
+            "detail": {"reason": "pre-preflight floor; no prior artifact on disk"},
+        }
+        _write_result_file(floor)
+        print(json.dumps(floor), flush=True)
+        sys.stderr.write("[bench] no disk-derivable seed; emitted no_result floor\n")
 
     tpu_ok = False
     ladder = [
@@ -1159,7 +1395,10 @@ def main() -> int:
         if cap < 60:
             break
         sys.stderr.write(f"[bench] preflight attempt {i+1} (cap {cap:.0f}s)\n")
-        proc = _run_child(["--preflight"], timeout=cap)
+        # attempt 1 runs the shrunk profile: init-watchdog capped inside the
+        # attempt budget, no matmul compile (see run_preflight) — a cold
+        # cache + slow first contact can no longer eat the whole first rung
+        proc = _run_child(["--preflight"] + (["--light"] if i == 0 else []), timeout=cap)
         if proc is not None and proc.returncode == 0:
             sys.stderr.write(f"[bench] preflight ok: {proc.stdout.strip()}\n")
             tpu_ok = True

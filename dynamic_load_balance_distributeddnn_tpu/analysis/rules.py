@@ -1271,6 +1271,193 @@ class RuleG008:
                         )
 
 
+# --------------------------------------------------------------------------
+# G009 — hot-path dispatch/compile bypassing the AOTCompileService registry
+
+
+class RuleG009:
+    code = "G009"
+    summary = (
+        "engine hot path dispatches or compiles an executable directly, "
+        "bypassing the AOTCompileService registry"
+    )
+    fix_hint = (
+        "resolve the executable from the AOT service registry "
+        "(service.get(key), the engine's _aot_resolve* helpers) and pass "
+        "the lazy jit only as the uncalled fallback VALUE — then warm and "
+        "speculative compiles are actually reused, dispatch hits the "
+        "pre-compiled object, and the compile guards can attribute what "
+        "compiles; a direct .lower()/.compile() likewise never registers "
+        "its executable for reuse"
+    )
+
+    # The rule only makes sense where a registry EXISTS: modules that hold
+    # an AOT service handle. Matching code tokens (not docstrings) keeps
+    # engines without a service — and the lint fixtures — out of scope.
+    _GATE_NAMES = {"AOTCompileService", "aot_service"}
+    _GATE_ATTRS = {"_aot", "aot_service"}
+    # Steady-state dispatch scopes: the per-epoch/per-window hot path. Warm
+    # scopes (the sanctioned serial A/B reference) and probes are excluded
+    # by name.
+    _DISPATCH_MARKERS = ("dispatch", "train_epoch")
+    _DISPATCH_NAMES = {"run_epoch"}
+    # Scopes allowed to lower/compile directly: the service and its
+    # plumbing (same convention as G007's timed-compile sanction).
+    _COMPILE_SCOPE_PREFIXES = ("compile", "_compile", "aot", "_aot")
+
+    def _module_gated(self, ctx) -> bool:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name) and node.id in self._GATE_NAMES:
+                return True
+            if isinstance(node, ast.Attribute) and node.attr in self._GATE_ATTRS:
+                return True
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                if any(
+                    (a.asname or a.name).split(".")[-1] in self._GATE_NAMES
+                    for a in node.names
+                ):
+                    return True
+        return False
+
+    def _is_dispatch_scope(self, fn: Optional[ast.AST]) -> bool:
+        if fn is None or isinstance(fn, ast.Lambda):
+            return False
+        name = fn.name.lower()
+        return name in self._DISPATCH_NAMES or any(
+            m in name for m in self._DISPATCH_MARKERS
+        )
+
+    # ---- pattern A: direct StepLibrary/jit dispatch in a dispatch scope
+
+    # Registry-resolution RHS tails: a local bound from one of these calls
+    # is the SANCTIONED dispatch handle (service executable, lazy fallback
+    # only on a registry miss) even when another branch binds it from a
+    # steps attribute.
+    _RESOLVE_TAILS_PREFIXES = ("_aot_resolve", "_resolve", "resolve")
+    _RESOLVE_TAILS = {"get", "compile_now"}
+
+    @classmethod
+    def _is_resolution_rhs(cls, value: ast.expr) -> bool:
+        if isinstance(value, ast.IfExp):
+            return cls._is_resolution_rhs(value.body) or cls._is_resolution_rhs(
+                value.orelse
+            )
+        if not isinstance(value, ast.Call):
+            return False
+        tail = _attr_tail(call_name(value))
+        return tail in cls._RESOLVE_TAILS or tail.startswith(
+            cls._RESOLVE_TAILS_PREFIXES
+        )
+
+    @staticmethod
+    def _module_jit_bound(ctx) -> Set[str]:
+        """Names bound to jitted callables at MODULE scope only (the
+        flow-insensitive module-wide set would taint every reuse of a common
+        local name like ``fn`` across unrelated functions)."""
+        bound: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and _innermost_function(node, ctx.parents) is None
+                and _rhs_binds_jitted(node.value)
+            ):
+                bound |= assign_targets(node)
+        return bound
+
+    def _check_dispatch_bypass(self, ctx, module_jit_bound) -> Iterator["Finding"]:
+        for fn in [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            if not self._is_dispatch_scope(fn):
+                continue
+            local_jitted: Set[str] = set()
+            local_resolved: Set[str] = set()
+            for stmt in ast.walk(fn):
+                if not (
+                    isinstance(stmt, ast.Assign)
+                    and _innermost_function(stmt, ctx.parents) is fn
+                ):
+                    continue
+                if self._is_resolution_rhs(stmt.value):
+                    local_resolved |= assign_targets(stmt)
+                elif _rhs_binds_jitted(stmt.value):
+                    local_jitted |= assign_targets(stmt)
+            bypass = (module_jit_bound | local_jitted) - local_resolved
+            for node in _function_calls(fn, ctx.parents):
+                name = call_name(node)
+                tail = _attr_tail(name)
+                direct = (
+                    tail in KNOWN_STEP_ATTRS and name and ".steps." in name
+                ) or (name in bypass)
+                if not direct:
+                    continue
+                yield _finding(
+                    self.code,
+                    ctx,
+                    node,
+                    f"dispatch scope `{fn.name}` calls `{name}` directly — "
+                    "the AOT service registry (warm + speculative compiles) "
+                    "is bypassed, so a shape already compiled in the "
+                    "background recompiles lazily in the foreground",
+                    self.fix_hint,
+                )
+
+    # ---- pattern B: direct lower()/compile() outside the service
+
+    def _check_unregistered_compiles(self, ctx) -> Iterator["Finding"]:
+        for fn in [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            if fn.name.startswith(self._COMPILE_SCOPE_PREFIXES):
+                continue
+            lowered = RuleG007._lowered_names(fn, ctx)
+            for node in _function_calls(fn, ctx.parents):
+                is_lower = (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "lower"
+                    # jit lowering takes the abstract args; a bare str.lower()
+                    # takes none
+                    and bool(node.args or node.keywords)
+                )
+                is_compile = (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "compile"
+                    and (
+                        (
+                            isinstance(node.func.value, ast.Call)
+                            and _attr_tail(call_name(node.func.value)) == "lower"
+                        )
+                        or (
+                            isinstance(node.func.value, ast.Name)
+                            and node.func.value.id in lowered
+                        )
+                    )
+                )
+                if not (is_lower or is_compile):
+                    continue
+                what = "lowers" if is_lower else "compiles"
+                yield _finding(
+                    self.code,
+                    ctx,
+                    node,
+                    f"`{fn.name}` {what} an XLA program directly "
+                    f"(`{call_name(node)}`) outside the AOT compile service — "
+                    "the executable never registers for reuse and the "
+                    "compile is invisible to the service's dedup/stats",
+                    self.fix_hint,
+                )
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        if not self._module_gated(ctx):
+            return
+        yield from self._check_dispatch_bypass(ctx, self._module_jit_bound(ctx))
+        yield from self._check_unregistered_compiles(ctx)
+
+
 # G007 reuses G002's timed-window extraction; share one instance.
 RULES_G002_WINDOWS = RuleG002()
 
@@ -1285,5 +1472,6 @@ RULES: Dict[str, object] = {
         RuleG006(),
         RuleG007(),
         RuleG008(),
+        RuleG009(),
     )
 }

@@ -102,6 +102,96 @@ def quantize_batches(
     return units * int(bucket)
 
 
+class ShareTrajectoryPredictor:
+    """One-step-ahead prediction of the solver's share vector.
+
+    The DBS update is a fixed-point iteration (r_i ∝ 1/c_i): after
+    convergence consecutive share vectors are identical, and during the
+    transient they move along a smooth trajectory (probe noise and EMA
+    smoothing dominate the residual). Scan-mode superstep executables
+    specialize on the whole per-group shape TUPLE, which has no finite
+    ±bucket adjacency to speculate over — but the tuple the NEXT epoch will
+    dispatch is a deterministic function of the next share vector, so
+    predicting the shares predicts the tuple key (the same
+    trajectory-prediction move *Online Dynamic Batching* makes for batch
+    schedules; PAPERS.md).
+
+    ``observe`` feeds each epoch's realized shares; ``predict`` returns the
+    expected next vector: last shares plus an EMA of the per-worker share
+    deltas (``alpha`` weights the newest delta). Velocity decays toward
+    zero at the fixed point, so a converged run predicts the tuple it is
+    already dispatching — speculation then costs one dedup lookup. Pure
+    host-side numpy; mispredictions only waste background compile work.
+    """
+
+    def __init__(self, alpha: float = 0.5):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        self.alpha = float(alpha)
+        self._last: Optional[np.ndarray] = None
+        self._velocity: Optional[np.ndarray] = None
+
+    def observe(self, shares: np.ndarray) -> None:
+        s = np.asarray(shares, dtype=np.float64).copy()
+        if self._last is not None and s.shape == self._last.shape:
+            delta = s - self._last
+            if self._velocity is None:
+                self._velocity = delta
+            else:
+                self._velocity = (
+                    self.alpha * delta + (1.0 - self.alpha) * self._velocity
+                )
+        elif self._last is not None:
+            self._velocity = None  # world size changed: restart the track
+        self._last = s
+
+    def predict(self) -> Optional[np.ndarray]:
+        """Next epoch's expected share vector (normalized, floor-clamped),
+        or None before the first observation."""
+        if self._last is None:
+            return None
+        p = self._last if self._velocity is None else self._last + self._velocity
+        p = np.clip(p, 1e-9, None)
+        return p / p.sum()
+
+    def predict_batches(
+        self,
+        global_batch: int,
+        bucket: int = 0,
+        max_share: Optional[float] = None,
+    ) -> Optional[np.ndarray]:
+        """Predicted integer per-worker batch sizes, run through the SAME
+        pipeline the plan builder uses (share cap -> integer split ->
+        bucket quantization) so a correct share prediction yields the
+        exact shape tuple the next plan will dispatch."""
+        p = self.predict()
+        if p is None:
+            return None
+        if max_share is not None:
+            cap = float(max_share)
+            if cap * len(p) < 1.0:
+                # n caps below 1/n cannot hold a distribution summing to 1;
+                # silently skipping the cap would return a vector the plan
+                # builder can never emit (every speculation a guaranteed
+                # miss) — make the caller's infeasible cap loud instead
+                raise ValueError(
+                    f"max_share={cap} is infeasible for {len(p)} workers "
+                    "(cap * n_workers must be >= 1)"
+                )
+            for _ in range(len(p)):
+                over = p > cap
+                if not over.any():
+                    break
+                excess = (p[over] - cap).sum()
+                p[over] = cap
+                free = ~over
+                p[free] += excess * p[free] / p[free].sum()
+        batches = integer_batch_split(p, global_batch)
+        if bucket > 0:
+            batches = quantize_batches(batches, bucket, global_batch)
+        return batches
+
+
 def rebalance(
     node_times: np.ndarray,
     shares: np.ndarray,

@@ -237,6 +237,28 @@ class Config:
                                        # single-flight (GIL-bound) either
                                        # way; the pool parallelizes the
                                        # backend-compile phase
+    aot_backend: str = "thread"        # "thread": backend compiles run on
+                                       # the in-process pool (XLA releases
+                                       # the GIL, but concurrent program
+                                       # compiles contend on a shared
+                                       # resource in the XLA:CPU emitter —
+                                       # and on small hosts on the machine
+                                       # itself). "process": the backend-
+                                       # compile phase runs in subprocess
+                                       # workers feeding the run's pinned
+                                       # persistent cache; the in-process
+                                       # step becomes a guaranteed cache-hit
+                                       # replay (runtime/compile_worker.py).
+                                       # Worth it on many-core hosts where
+                                       # per-program compiles no longer
+                                       # share an emitter; bench
+                                       # compile_workers_ab measures it.
+    aot_workers: int = 0               # process-backend subprocess count
+                                       # (0 = auto: min(4, cpus)); each
+                                       # worker is a full spawned JAX
+                                       # runtime (~2-4 s startup, paid once,
+                                       # overlapped with the run's own
+                                       # warm-up)
     aot_speculate: bool = True         # when a rebalance dispatches a
                                        # ladder rung, background-compile the
                                        # ADJACENT rungs (±bucket) while the
@@ -244,6 +266,21 @@ class Config:
                                        # rebalance's fresh layout is already
                                        # compiled and the recompile sentinel
                                        # stays silent (dbs runs only)
+    speculate_scan: bool = True        # scan-mode shape-TUPLE speculation:
+                                       # predict the solver's next share
+                                       # vector (EMA of per-worker share
+                                       # deltas, balance/solver.py
+                                       # ShareTrajectoryPredictor), quantize
+                                       # it exactly like the plan builder,
+                                       # and background-compile the
+                                       # predicted superstep (shapes,
+                                       # window) keys in the epoch's untimed
+                                       # tail. Mispredictions cost only
+                                       # background work; hits remove the
+                                       # last steady-state foreground
+                                       # compile class (tuples have no
+                                       # finite ±bucket adjacency).
+                                       # Requires aot_speculate.
     device_cache: str = "auto"         # "auto"|"on"|"off": keep the train
                                        # arrays resident in HBM and feed each
                                        # epoch by INDEX (on-device gather in
@@ -363,6 +400,10 @@ class Config:
             raise ValueError("superstep_window must be >= 1")
         if self.aot_pool < 0:
             raise ValueError("aot_pool must be >= 0 (0 = auto)")
+        if self.aot_backend not in ("thread", "process"):
+            raise ValueError("aot_backend must be 'thread' or 'process'")
+        if self.aot_workers < 0:
+            raise ValueError("aot_workers must be >= 0 (0 = auto)")
         if self.compress_grads and self.dynamic_batch_size and not self.fused_dbs:
             raise ValueError(
                 "compress_grads rides a fused path (the elastic DBS combine "
@@ -515,9 +556,22 @@ def get_parser() -> argparse.ArgumentParser:
                         "execute-to-compile warm loop.")
     p.add_argument("--aot_pool", type=int, default=d.aot_pool,
                    help="AOT compile pool width (0 = auto).")
+    p.add_argument("--aot_backend", type=str, default=d.aot_backend,
+                   choices=["thread", "process"],
+                   help="Where AOT backend compiles run: in-process threads, "
+                        "or subprocess workers feeding the persistent cache "
+                        "(replayed in-process as guaranteed cache hits; "
+                        "scales multi-program compile throughput on "
+                        "many-core hosts).")
+    p.add_argument("--aot_workers", type=int, default=d.aot_workers,
+                   help="Process-backend compile worker count (0 = auto).")
     p.add_argument("--aot_speculate", type=str2bool, default=d.aot_speculate,
                    help="Background-compile adjacent ladder rungs during "
                         "epochs so mid-run rebalances never block on XLA.")
+    p.add_argument("--speculate_scan", type=str2bool, default=d.speculate_scan,
+                   help="Scan mode: predict the solver's next share vector "
+                        "and background-compile the predicted superstep "
+                        "shape-tuple keys in the untimed epoch tail.")
     p.add_argument("--device_cache", type=str, default=d.device_cache,
                    choices=["auto", "on", "off"],
                    help="Keep train arrays HBM-resident and feed epochs by "

@@ -8,6 +8,13 @@ Usage::
     graftscope summarize traces/run.trace.json --epoch 3  # one epoch only
     graftscope diff before.trace.json after.trace.json    # phase deltas
     graftscope summarize run.trace.json --json            # machine-readable
+    graftscope merge run.trace.json -o merged.json        # + worker traces
+
+``summarize`` and ``merge`` automatically stitch compile-worker trace files
+(``compile_worker_*.trace.json``, written per process by the AOT service's
+process backend — runtime/compile_worker.py) found next to the run trace,
+so compile walls attribute across processes as pid-tagged tracks
+(``--no-workers`` reads the run trace alone).
 
 Exit status: 0 on success, 2 on usage/IO errors.
 """
@@ -15,11 +22,56 @@ Exit status: 0 on success, 2 on usage/IO errors.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 from typing import Dict, List, Optional
 
-from dynamic_load_balance_distributeddnn_tpu.obs.trace import attribution, load_trace
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
+    attribution,
+    load_trace,
+    merge_trace_events,
+    merge_trace_files,
+    merged_names,
+)
+
+
+def _worker_traces(path: str) -> List[str]:
+    """Compile-worker span files sitting next to a run trace that are NOT
+    already stitched into it (the engine merges at save and records the
+    filenames in the trace's ``graftscope.merged`` marker — re-stitching
+    those would double-count their compile walls)."""
+    done = set(merged_names(path))
+    pattern = os.path.join(os.path.dirname(path) or ".", "compile_worker_*.trace.json")
+    return sorted(
+        p
+        for p in glob.glob(pattern)
+        if os.path.abspath(p) != os.path.abspath(path)
+        and os.path.basename(p) not in done
+    )
+
+
+def _load_stitched(path: str, with_workers: bool) -> "tuple[List[dict], List[str]]":
+    """(events, worker-trace provenance): stitches un-merged sibling worker
+    files in; provenance also includes files the engine already merged, so
+    the per-pid compile table renders for pre-stitched traces too."""
+    workers = _worker_traces(path) if with_workers else []
+    stitched = (workers + merged_names(path)) if with_workers else []
+    if workers:
+        return merge_trace_events([path] + workers), stitched
+    return load_trace(path), stitched
+
+
+def _compile_walls_by_pid(events: List[dict]) -> Dict[int, float]:
+    """Total cat=="compile" span seconds per pid — the cross-process compile
+    attribution the worker stitching exists for."""
+    walls: Dict[int, float] = {}
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("cat") == "compile":
+            pid = ev.get("pid", 0)
+            walls[pid] = walls.get(pid, 0.0) + float(ev.get("dur", 0.0)) / 1e6
+    return walls
 
 
 def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
@@ -34,18 +86,29 @@ def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
     return "\n".join(out)
 
 
-def summarize(path: str, epoch: Optional[int] = None, as_json: bool = False) -> str:
-    att = attribution(load_trace(path))
+def summarize(
+    path: str,
+    epoch: Optional[int] = None,
+    as_json: bool = False,
+    with_workers: bool = True,
+) -> str:
+    events, workers = _load_stitched(path, with_workers)
+    att = attribution(events)
+    compile_walls = _compile_walls_by_pid(events) if workers else {}
     epochs = att["epochs"]
     if epoch is not None:
         epochs = {k: v for k, v in epochs.items() if int(k) == epoch}
         if not epochs:
             raise ValueError(f"epoch {epoch} not present in {path}")
     if as_json:
-        return json.dumps(
-            {"epochs": epochs, "phase_totals_s": att["phase_totals_s"],
-             "coverage_min": att["coverage_min"]}
-        )
+        payload = {"epochs": epochs, "phase_totals_s": att["phase_totals_s"],
+                   "coverage_min": att["coverage_min"]}
+        if workers:
+            payload["worker_traces"] = workers
+            payload["compile_wall_s_by_pid"] = {
+                str(k): round(v, 6) for k, v in sorted(compile_walls.items())
+            }
+        return json.dumps(payload)
     out = []
     for ep, info in sorted(epochs.items(), key=lambda kv: int(kv[0])):
         wall = info["wall_s"]
@@ -77,6 +140,18 @@ def summarize(path: str, epoch: Optional[int] = None, as_json: bool = False) -> 
         out.append(_fmt_table(rows, ["phase", "seconds"]))
         if att["coverage_min"] is not None:
             out.append(f"worst-epoch attribution: {att['coverage_min'] * 100:.1f}%")
+    if workers:
+        out.append("")
+        out.append(
+            f"stitched {len(workers)} compile-worker trace file(s); "
+            "compile wall by pid:"
+        )
+        out.append(
+            _fmt_table(
+                [[str(pid), f"{secs:.4f}"] for pid, secs in sorted(compile_walls.items())],
+                ["pid", "compile s"],
+            )
+        )
     return "\n".join(out).rstrip()
 
 
@@ -124,10 +199,20 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("trace")
     s.add_argument("--epoch", type=int, default=None)
     s.add_argument("--json", action="store_true")
+    s.add_argument("--no-workers", action="store_true",
+                   help="do not stitch sibling compile_worker_*.trace.json")
     d = sub.add_parser("diff", help="phase-total deltas between two traces")
     d.add_argument("trace_a")
     d.add_argument("trace_b")
     d.add_argument("--json", action="store_true")
+    m = sub.add_parser(
+        "merge",
+        help="write the run trace with sibling compile-worker traces "
+        "stitched in (one Perfetto-loadable artifact)",
+    )
+    m.add_argument("trace")
+    m.add_argument("-o", "--out", default=None,
+                   help="output path (default: rewrite the run trace)")
     return p
 
 
@@ -135,7 +220,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     try:
         if args.cmd == "summarize":
-            print(summarize(args.trace, epoch=args.epoch, as_json=args.json))
+            print(
+                summarize(
+                    args.trace,
+                    epoch=args.epoch,
+                    as_json=args.json,
+                    with_workers=not args.no_workers,
+                )
+            )
+        elif args.cmd == "merge":
+            workers = _worker_traces(args.trace)
+            out = merge_trace_files(args.trace, workers, out_path=args.out)
+            print(f"merged {len(workers)} worker trace(s) -> {out}")
         else:
             print(diff(args.trace_a, args.trace_b, as_json=args.json))
     except (OSError, ValueError, KeyError) as exc:

@@ -146,6 +146,11 @@ class Tracer:
         # threads emit without a lock on the hot path
         self._events: deque = deque(maxlen=ring_size if mode == "ring" else None)
         self._epoch_base = time.perf_counter()
+        # wall-clock twin of the perf_counter base: perf_counter is not
+        # comparable across processes, so cross-process stitching
+        # (merge_trace_files) realigns each file's events by the difference
+        # of these unix stamps
+        self._base_unix = time.time()
         self._current_epoch: Optional[int] = None
         self._thread_names: Dict[int, str] = {}
         return self
@@ -154,6 +159,7 @@ class Tracer:
         """Drop buffered events; keep the mode."""
         self._events.clear()
         self._epoch_base = time.perf_counter()
+        self._base_unix = time.time()
         self._current_epoch = None
 
     def set_epoch(self, epoch: Optional[int]) -> None:
@@ -271,7 +277,13 @@ class Tracer:
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
-        payload = {"traceEvents": self.chrome_events(), "displayTimeUnit": "ms"}
+        payload = {
+            "traceEvents": self.chrome_events(),
+            "displayTimeUnit": "ms",
+            # cross-process alignment key (see merge_trace_files); extra
+            # top-level keys are legal Chrome-trace metadata
+            "graftscope": {"base_unix": self._base_unix},
+        }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f)
@@ -290,11 +302,109 @@ class Tracer:
 def load_trace(path: str) -> List[dict]:
     """Chrome-trace JSON -> the traceEvents list (accepts both the object
     form this module writes and a bare event array)."""
+    return _load_trace_payload(path)[0]
+
+
+def _load_trace_payload(path: str) -> "Tuple[List[dict], Optional[float]]":
+    """(traceEvents, graftscope base_unix or None) from one trace file."""
     with open(path) as f:
         data = json.load(f)
     if isinstance(data, dict):
-        return list(data.get("traceEvents", []))
-    return list(data)
+        base = (data.get("graftscope") or {}).get("base_unix")
+        return list(data.get("traceEvents", [])), base
+    return list(data), None
+
+
+def merged_names(path: str) -> List[str]:
+    """Basenames of worker trace files already stitched into ``path`` (the
+    ``graftscope.merged`` marker merge_trace_files writes) — so a second
+    stitch pass (the engine merges at save; `graftscope summarize` stitches
+    siblings) skips them instead of double-counting their spans."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if isinstance(data, dict):
+        return list((data.get("graftscope") or {}).get("merged", []))
+    return []
+
+
+def merge_trace_events(paths: List[str]) -> List[dict]:
+    """Stitch several trace files' events into one pid-tagged stream.
+
+    The first path is the PRIMARY (its timeline is the reference frame);
+    each additional file — e.g. the compile workers' per-process span files
+    (runtime/compile_worker.py) — contributes its events shifted into the
+    primary's clock using the ``graftscope.base_unix`` stamps both files
+    carry (perf_counter timelines are per-process; the unix-time twin of the
+    tracer base makes them comparable to wall-clock accuracy). Files from
+    pids the primary doesn't know get a ``process_name`` metadata event
+    derived from their filename, so Perfetto labels the worker tracks."""
+    out: List[dict] = []
+    base0: Optional[float] = None
+    for i, path in enumerate(paths):
+        events, base = _load_trace_payload(path)
+        if i == 0:
+            base0 = base
+        shift_us = 0.0
+        if i > 0 and base is not None and base0 is not None:
+            shift_us = (base - base0) * 1e6
+        named = {
+            e.get("pid")
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        pids = {e.get("pid") for e in events if e.get("pid") is not None}
+        label = os.path.basename(path)
+        for suffix in (".json", ".trace"):
+            if label.endswith(suffix):
+                label = label[: -len(suffix)]
+        for pid in sorted(p for p in pids - named if p is not None):
+            out.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "args": {"name": label if i > 0 else "trainer"},
+                }
+            )
+        for ev in events:
+            if shift_us and "ts" in ev:
+                ev = dict(ev)
+                ev["ts"] = round(ev["ts"] + shift_us, 3)
+            out.append(ev)
+    return out
+
+
+def merge_trace_files(
+    primary: str, extra_paths: List[str], out_path: Optional[str] = None
+) -> str:
+    """Merge ``extra_paths`` (compile-worker trace files) into ``primary``
+    (in place by default) so one artifact holds the run's host spans AND the
+    workers' compile walls as pid-tagged tracks. Returns the written path."""
+    out_path = out_path or primary
+    extras = [p for p in extra_paths if os.path.exists(p)]
+    paths = [primary] + extras
+    _, base = _load_trace_payload(primary)
+    payload = {
+        "traceEvents": merge_trace_events(paths),
+        "displayTimeUnit": "ms",
+        # record what was stitched so a later pass (summarize auto-stitching
+        # siblings) skips these files instead of double-counting
+        "graftscope": {
+            "merged": sorted(
+                set(merged_names(primary)) | {os.path.basename(p) for p in extras}
+            )
+        },
+    }
+    if base is not None:
+        payload["graftscope"]["base_unix"] = base
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, out_path)
+    return out_path
 
 
 def attribution(events: List[dict]) -> Dict:

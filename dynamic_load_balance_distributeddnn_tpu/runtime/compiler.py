@@ -36,6 +36,18 @@ thread name, which analysis/guards.py uses to keep background compiles out
 of the engine's recompile sentinel (they are deliberate, overlapped work,
 not a shape falling off the ladder) while still counting them in budgets
 opened with ``include_background=True``.
+
+``backend="process"`` additionally routes the backend-compile phase of
+each job to subprocess workers (runtime/compile_worker.py): the pool
+thread lowers, ships the serialized (StableHLO, CompileOptions) payload to
+a worker, and — once the worker has compiled it into the run's pinned
+persistent cache — replays ``lowered.compile()`` in-process as a
+guaranteed cache hit. In-process concurrent compiles contend ~fully on a
+shared resource in the XLA:CPU emitter (jobs overlap 2x but stretch 2x);
+worker processes each own an emitter, so multi-program compile throughput
+finally scales with cores (bench ``compile_workers_ab``). A worker that
+dies or rejects a payload costs nothing: the replay compiles in-process,
+exactly the ``backend="thread"`` behavior.
 """
 
 from __future__ import annotations
@@ -61,6 +73,14 @@ def default_pool_size() -> int:
     """Pool width when the config leaves it at 0 (auto): enough to keep the
     backend compiler busy without convoying tracing threads on the GIL."""
     return max(2, min(8, os.cpu_count() or 2))
+
+
+# Ceiling on one worker job's wall (submit -> ack). Generous: the slowest
+# single program observed (DenseNet-121 on the CPU tier) compiles in
+# minutes, not tens of minutes — hitting this means a wedged worker, and
+# the job falls back to an in-process compile instead of hanging the pool
+# thread (and the engine's drain barrier) forever.
+WORKER_JOB_TIMEOUT_S = float(os.environ.get("GRAFT_WORKER_JOB_TIMEOUT_S", 1800))
 
 
 # Live pools, drained at interpreter shutdown. The hook registers with
@@ -157,6 +177,14 @@ class AOTCompileService:
     created lazily on the first ``submit`` — a service used only for
     ``compile_now`` never spawns a thread.
 
+    ``backend``: ``"thread"`` (in-process backend compiles, the default) or
+    ``"process"`` (backend compiles run in subprocess workers feeding the
+    persistent cache; the in-process step becomes a cache-hit replay — see
+    runtime/compile_worker.py). ``process_workers``: subprocess count
+    (0 = auto). The worker pool spawns lazily with the thread pool and is
+    shared by every job; any worker-side failure degrades that one job to
+    an in-process compile.
+
     ``tick``: optional callback invoked after every finished compile job
     (the engine passes the watchdog heartbeat, so a long TPU compile ladder
     keeps answering the stall watchdog the way the execute-to-compile warm
@@ -168,7 +196,25 @@ class AOTCompileService:
         workers: int = 0,
         logger=None,
         tick: Optional[Callable[[], None]] = None,
+        backend: str = "thread",
+        process_workers: int = 0,
+        trace_dir: Optional[str] = None,
     ):
+        if backend not in ("thread", "process"):
+            raise ValueError(f"backend must be 'thread' or 'process', got {backend!r}")
+        self._backend = backend
+        self._process_workers = int(process_workers)
+        self._trace_dir = trace_dir
+        self._worker_pool = None  # CompileWorkerPool, spawned lazily
+        self._worker_pool_failed = False
+        if backend == "process" and not int(workers):
+            # keep the workers fed: while worker k compiles job i, thread
+            # k should already be lowering job i+1
+            from dynamic_load_balance_distributeddnn_tpu.runtime.compile_worker import (
+                default_worker_count,
+            )
+
+            workers = (self._process_workers or default_worker_count()) + 1
         self._workers = int(workers) or default_pool_size()
         self._logger = logger
         self._tick = tick
@@ -187,6 +233,11 @@ class AOTCompileService:
             "failed": 0,
             "speculative": 0,
             "compile_wall_s": 0.0,
+            # process-backend accounting: jobs whose backend compile ran in
+            # a worker (replay hit the persistent cache) vs jobs that fell
+            # back to a real in-process compile
+            "worker_compiled": 0,
+            "worker_fallback": 0,
         }
 
     # ------------------------------------------------------------- internals
@@ -195,6 +246,75 @@ class AOTCompileService:
         if self._pool is None:
             self._pool = _CompilePool(self._workers, AOT_THREAD_PREFIX)
         return self._pool
+
+    def _ensure_worker_pool(self):
+        """Spawn the subprocess worker pool on first use (process backend).
+        Returns the pool or None (spawn failed once → stay degraded: every
+        job compiles in-process, which is just the thread backend)."""
+        if self._backend != "process":
+            return None
+        with self._lock:
+            if self._worker_pool is not None or self._worker_pool_failed:
+                return self._worker_pool
+        try:
+            from dynamic_load_balance_distributeddnn_tpu.runtime.compile_worker import (
+                CompileWorkerPool,
+                default_worker_count,
+                ensure_persistent_cache,
+            )
+
+            cache_dir = ensure_persistent_cache(self._logger)
+            if cache_dir is None:
+                raise RuntimeError("persistent compilation cache unavailable")
+            pool = CompileWorkerPool(
+                self._process_workers or default_worker_count(),
+                cache_dir,
+                trace_dir=self._trace_dir,
+                logger=self._logger,
+            )
+        except Exception as e:
+            with self._lock:
+                self._worker_pool_failed = True
+            if self._logger is not None:
+                self._logger.warning(
+                    f"compile workers unavailable ({e!r}); AOT service "
+                    "degrades to in-process compiles"
+                )
+            return None
+        with self._lock:
+            if self._worker_pool is None:
+                self._worker_pool = pool
+                return pool
+        pool.shutdown()  # lost the race to a concurrent spawner
+        return self._worker_pool
+
+    def _offload_to_worker(self, key: Hashable, lowered, tr, key_args) -> None:
+        """Process backend: ship the lowered program to a worker and wait
+        for its cache write. Purely best-effort — on ANY failure the
+        caller's replay compiles in-process (the designed fallback)."""
+        from dynamic_load_balance_distributeddnn_tpu.runtime.compile_worker import (
+            extract_lowering_payload,
+        )
+
+        pool = self._ensure_worker_pool()
+        ok = False
+        if pool is not None and pool.wait_ready():
+            payload = extract_lowering_payload(lowered)
+            if payload is not None:
+                with tr.span("aot_worker_wait", cat="compile", args=key_args):
+                    job_id = pool.submit(repr(key), payload)
+                    # bounded wait: the pool resolves lost jobs when it sees
+                    # a worker die, but a wedged (not dead) worker would
+                    # otherwise hang this pool thread — and with it the
+                    # engine's pre-wall drain barrier — forever
+                    ok, err = pool.wait(job_id, timeout=WORKER_JOB_TIMEOUT_S)
+                if not ok and self._logger is not None:
+                    self._logger.warning(
+                        f"compile worker failed for {key}: {err} — "
+                        "compiling in-process"
+                    )
+        with self._lock:
+            self._stats["worker_compiled" if ok else "worker_fallback"] += 1
 
     def _compile_job(self, key: Hashable, fn, args: Sequence):
         t0 = time.perf_counter()
@@ -208,6 +328,10 @@ class AOTCompileService:
             with self._lower_lock:
                 with tr.span("aot_lower", cat="compile", args=key_args):
                     lowered = fn.lower(*args)
+            if self._backend == "process":
+                # worker pre-pays the XLA emitter work into the persistent
+                # cache; the compile() below is then a deserialization
+                self._offload_to_worker(key, lowered, tr, key_args)
             with tr.span("aot_compile", cat="compile", args=key_args):
                 compiled = lowered.compile()
         except BaseException:
@@ -349,10 +473,41 @@ class AOTCompileService:
         with self._lock:
             return dict(self._stats)
 
+    def worker_trace_paths(self) -> List[str]:
+        """Chrome-trace files written by compile workers (process backend;
+        available after close/shutdown — workers save at exit). The engine
+        stitches these into the run trace (obs/trace.py merge_trace_files)."""
+        with self._lock:
+            pool = self._worker_pool
+        return pool.trace_paths() if pool is not None else []
+
+    def flush_workers(self) -> List[str]:
+        """Shut down the subprocess workers so they write their graftscope
+        trace files (saved at worker exit), and return the written paths.
+        The service stays usable: later jobs degrade to in-process compiles
+        (the thread-backend behavior) — intended only at end of run, before
+        the engine saves and stitches the run trace."""
+        with self._lock:
+            pool = self._worker_pool
+        if pool is None:
+            return []
+        pool.shutdown()
+        return pool.trace_paths()
+
     def close(self, wait: bool = True) -> None:
         with self._lock:
             pool, self._pool = self._pool, None
+            # keep _worker_pool set while pending jobs drain (they still
+            # offload to live workers), but forbid a respawn: a drain-time
+            # job racing _ensure_worker_pool must not spin up a fresh pool
+            # that close() would then leak
+            self._worker_pool_failed = True
+            wpool = self._worker_pool
         if pool is not None:
             pool.shutdown(drop_pending=not wait)
             if wait:
                 self.wait()
+        if wpool is not None:
+            # after the drain: workers idle, shut them down (writes their
+            # trace files); the handle stays for trace-path collection
+            wpool.shutdown()

@@ -42,7 +42,10 @@ from dynamic_load_balance_distributeddnn_tpu.balance import (
     integer_batch_split,
     rebalance,
 )
-from dynamic_load_balance_distributeddnn_tpu.balance.solver import quantize_batches
+from dynamic_load_balance_distributeddnn_tpu.balance.solver import (
+    ShareTrajectoryPredictor,
+    quantize_batches,
+)
 from dynamic_load_balance_distributeddnn_tpu.config import Config
 from dynamic_load_balance_distributeddnn_tpu.data import (
     DatasetBundle,
@@ -179,7 +182,14 @@ class Trainer:
         self._aot: Optional[AOTCompileService] = None
         if cfg.aot_warm:
             self._aot = AOTCompileService(
-                workers=cfg.aot_pool, logger=self.logger, tick=heartbeat
+                workers=cfg.aot_pool,
+                logger=self.logger,
+                tick=heartbeat,
+                backend=cfg.aot_backend,
+                process_workers=cfg.aot_workers,
+                # workers write their own graftscope trace files next to the
+                # run trace; save_trace stitches them in (pid-tagged tracks)
+                trace_dir=cfg.trace_dir if cfg.trace != "off" else None,
             )
             self.steps.aot_service = self._aot
             # tie the pool's lifetime to the trainer: processes that build
@@ -288,6 +298,12 @@ class Trainer:
         # warning is cross-checked against (run_epoch).
         self._host_meter = HostOverheadMeter()
         self._superstep_keys: set = set()
+        # Solver-trajectory predictor (balance/solver.py): one-step-ahead
+        # share-vector prediction feeding scan-mode shape-TUPLE speculation
+        # (config.speculate_scan) — tuples have no finite ±bucket adjacency,
+        # but the NEXT tuple is a deterministic function of the next share
+        # vector, which the solver's smooth trajectory makes predictable.
+        self._share_predictor = ShareTrajectoryPredictor()
         # graftscope (obs/trace.py + obs/registry.py): the process-wide span
         # tracer — configured here from the run config, shared by every
         # instrumented module (pipeline, AOT service, solver, watchdog) —
@@ -632,6 +648,116 @@ class Trainer:
         svc.submit(k, self.steps.aot_lowerables()[name], args, speculative=speculative)
         return [k]
 
+    def _aot_fused_key(self, n_win: int, width: int, slow_len: int) -> tuple:
+        name = "fused_epoch_idx" if self._use_device_cache else "fused_epoch"
+        return (name, int(n_win), int(width), int(slow_len))
+
+    def _aot_submit_fused(self, n_win: int, width: int, slow_len: int) -> list:
+        """Queue one fused whole-epoch-scan window executable
+        (``fused_epoch``/``fused_epoch_idx``) as an AOT job: the MESH-sharded
+        program lowers from ``ShapeDtypeStruct`` specs carrying explicit
+        ``NamedSharding``s (batch axis split over the data mesh, replicated
+        scalars), with the live TrainState riding in for exact leaf
+        shardings/committed-ness — the multi-device lowering the service was
+        previously gated away from (single-host probes only). Single-process
+        only: multi-host runs keep the lazy path."""
+        svc = self._aot
+        if svc is None or self.n_proc > 1:
+            return []
+        from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+            batch_sharding,
+        )
+
+        k = self._aot_fused_key(n_win, width, slow_len)
+        if svc.has(k):
+            return [k]
+        mesh = self.mesh
+        use_cache = self._use_device_cache
+
+        def sds(shape, dt, sh):
+            return jax.ShapeDtypeStruct(tuple(int(s) for s in shape), dt, sharding=sh)
+
+        def win_spec(shape, dt):
+            full = (n_win, width) + tuple(shape)
+            return sds(full, dt, batch_sharding(mesh, len(full), axis_dim=1))
+
+        (xs_, xd), (ys_, yd), (ws_sh, wd) = [
+            (s[1:], dt) for s, dt in self._dummy_arg_shapes(1)
+        ]
+        w_t = win_spec(ws_sh, wd)
+        slow_t = sds((slow_len,), jnp.int32, batch_sharding(mesh, 1))
+        seed_t = sds((), jnp.int32, replicated_sharding(mesh))
+        if use_cache:
+            cache_x, cache_y = self._device_cache_replicated()
+            args = (
+                self.state, cache_x, cache_y,
+                win_spec((), jnp.int32), w_t, slow_t, seed_t,
+            )
+        else:
+            args = (self.state, win_spec(xs_, xd), win_spec(ys_, yd), w_t,
+                    slow_t, seed_t)
+        svc.submit(k, self.steps.aot_lowerables()[k[0]], args)
+        return [k]
+
+    def _resolve_fused_epoch(self, n_win: int, width: int, slow_len: int, args):
+        """Compiled fused-epoch executable for one window geometry: the
+        service registry if present, a blocking inline ``compile_now`` on a
+        cold key (same wall position as the lazy compile, but the executable
+        registers for reuse and the compile attributes as deliberate AOT
+        work, not a sentinel-visible foreground recompile), the lazy jit
+        wrapper on failure or multi-host."""
+        name = "fused_epoch_idx" if self._use_device_cache else "fused_epoch"
+        lazy = self.steps.aot_lowerables()[name]
+        if self._aot is None or self.n_proc > 1:
+            return lazy
+        k = self._aot_fused_key(n_win, width, slow_len)
+        fn = self._aot.get(k)
+        if fn is not None:
+            return fn
+        try:
+            return self._aot.compile_now(k, lazy, args)
+        except Exception as e:
+            if k not in self._aot_failed_logged:
+                self._aot_failed_logged.add(k)
+                self.logger.warning(
+                    f"AOT fused compile failed for {k}: {e!r} — using lazy jit"
+                )
+            return lazy
+
+    def _aot_submit_combine(self) -> list:
+        """Queue the mesh-wide combine twins (``combine_update`` +
+        ``combine_probe``): their stacked-grads input is the params tree with
+        a leading [n_dev] axis sharded over the data mesh
+        (steps.stack_partials), a shape that never changes across the run —
+        one key each. Every elastic epoch dispatches combine_update per step
+        and every probe runs combine_probe, so these were the last
+        steady-state executables compiling lazily on the multi-device path."""
+        svc = self._aot
+        if svc is None or self.n_proc > 1:
+            return []
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS
+
+        sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        stacked_t = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(
+                (self.n_dev,) + tuple(p.shape), p.dtype, sharding=sh
+            ),
+            self.state.params,
+        )
+        keys = []
+        for name in ("combine_update", "combine_probe"):
+            k = (name,)
+            if not svc.has(k):
+                svc.submit(k, getattr(self.steps, name), (self.state, stacked_t))
+            keys.append(k)
+        return keys
+
+    def _aot_resolve_combine(self, name: str, fallback):
+        if self._aot is None:
+            return fallback
+        return self._aot.get((name,)) or fallback
+
     def _submit_warm_aot(self) -> None:
         """AOT warm-start: submit the whole compile universe and return
         immediately — the pool compiles while the engine builds epoch 0's
@@ -644,13 +770,32 @@ class Trainer:
         warm_acc = any(len(g) > 1 for g in self.topology.groups.values())
         mode = self._elastic_mode()
         wins: tuple = ()
-        plan0 = None
+        plan0 = self._build_plan(0, integer_batch_split(self.shares, cfg.batch_size))
         if mode in ("window", "scan"):
-            plan0 = self._build_plan(0, integer_batch_split(self.shares, cfg.batch_size))
             wins = tuple(
                 sorted({s1 - s0 for s0, s1 in self._elastic_ranges(plan0.num_steps)})
             )
-        n = 0
+        n = n_fused = self._submit_warm_fused(plan0)
+        if n_fused:
+            # fused-path runs never dispatch the elastic ladder or the
+            # combine twins (the combine lives inside the SPMD program) —
+            # only the standalone probe rungs at the plan's TRUE shapes feed
+            # the balancer signal (fused-DBS mode)
+            if cfg.dynamic_batch_size or self._needs_iter_cost:
+                for d in self.topology.used_device_indices:
+                    for r in self.topology.groups[d]:
+                        b = plan0.workers[self.rank_lo + r].padded_batch
+                        n += len(
+                            self._aot_submit_worker_steps(
+                                d, b, (), want_acc=False, want_plain=True
+                            )
+                        )
+            self.logger.info(
+                f"AOT warm: submitted {n} compile jobs ({n_fused} fused "
+                "mesh programs + probe rungs) — no dummy execution; compiles "
+                "overlap epoch-0 plan build, drained before its wall"
+            )
+            return
         for d in self.topology.used_device_indices:
             for b in ladder:
                 n += len(
@@ -664,11 +809,38 @@ class Trainer:
             padded = [plan0.workers[self.rank_lo + r].padded_batch for r in group]
             for win in wins:
                 n += len(self._aot_submit_superstep(padded, win))
+        else:
+            n += len(self._aot_submit_combine())
         self.logger.info(
             f"AOT warm: submitted {n} compile jobs ({len(ladder)} ladder rungs "
             f"up to {max_b}, windows {list(wins)}) — no dummy execution; "
             "compiles overlap epoch-0 plan build, drained before its wall"
         )
+
+    def _submit_warm_fused(self, plan0) -> int:
+        """Warm-submit the fused whole-epoch executables when epoch 0 will
+        take a fused path (mirrors _dispatch_epoch's selection on the
+        epoch-0 plan): the mesh program's compile overlaps the plan build
+        instead of landing inside the excluded epoch 0. Returns the number
+        of submitted keys (0 = elastic run)."""
+        cfg = self.cfg
+        if self._aot is None or self.n_proc > 1:
+            return 0
+        if self._can_use_fused(plan0):
+            width = sum(w.padded_batch for w in plan0.workers)
+            slow_len = cfg.world_size
+        elif self._can_use_fused_dbs(plan0):
+            width = cfg.world_size * self._cap_b
+            slow_len = cfg.world_size
+        elif self._can_use_packed(plan0):
+            width = self._cap_packed
+            slow_len = 1
+        else:
+            return 0
+        n = 0
+        for s0, s1 in self._chunk_ranges(plan0.num_steps):
+            n += len(self._aot_submit_fused(s1 - s0, width, slow_len))
+        return n
 
     def _aot_stage_plan(self, plan) -> tuple:
         """Submit this plan's missing executables (a mid-run rebalance on a
@@ -704,24 +876,27 @@ class Trainer:
                     needed += self._aot_submit_worker_steps(
                         d, b, wins if mode == "window" else (), want_acc, want_plain=True
                     )
+            needed += self._aot_submit_combine()
         return tuple(dict.fromkeys(needed))
 
     def _maybe_speculate(self, plan) -> None:
-        """Background-compile the ladder rungs ADJACENT to this plan's
-        (±bucket, capacity-clamped): the next rebalance moves each worker at
-        most a few rungs, so its fresh layout is compiled before it is
-        dispatched and the recompile sentinel stays silent. Called from
-        run_epoch AFTER the timed region — the jobs overlap the untimed
+        """Background-compile the executables the NEXT rebalance is likely to
+        dispatch. Ladder modes: the rungs ADJACENT to this plan's (±bucket,
+        capacity-clamped) — the next rebalance moves each worker at most a
+        few rungs. Scan mode (config.speculate_scan): the superstep shape
+        TUPLES have no finite adjacency, so the solver's next share vector is
+        PREDICTED (ShareTrajectoryPredictor) and run through the plan
+        builder's own quantization — a share hit is a tuple-key hit. Called
+        from run_epoch AFTER the timed region — the jobs overlap the untimed
         validation tail (and drain at the next epoch's pre-wall barrier), so
-        timed walls never share cores with the compiler. Only meaningful on
-        the snapped ladder — unsnapped plans have no finite adjacency."""
+        timed walls never share cores with the compiler; a misprediction
+        costs only background work."""
         cfg = self.cfg
-        if (
-            self._aot is None
-            or not cfg.aot_speculate
-            or not cfg.dynamic_batch_size
-            or self._elastic_mode() == "scan"  # shape TUPLES: no finite adjacency
-        ):
+        if self._aot is None or not cfg.aot_speculate or not cfg.dynamic_batch_size:
+            return
+        if self._elastic_mode() == "scan":
+            if cfg.speculate_scan:
+                self._speculate_scan_tuple()
             return
         wins = ()
         if self._elastic_mode() == "window":
@@ -729,6 +904,30 @@ class Trainer:
                 sorted({s1 - s0 for s0, s1 in self._elastic_ranges(plan.num_steps)})
             )
         self._aot_speculate(plan, wins)
+
+    def _speculate_scan_tuple(self) -> None:
+        """Predict the next epoch's quantized share vector, build the plan it
+        implies (host-side arithmetic only), and queue its superstep
+        (shape-tuple, window) keys speculatively. A converged run predicts
+        the tuple it already dispatches — the submit dedups to a lookup."""
+        cfg = self.cfg
+        bucket = cfg.bucket if (cfg.snap_to_bucket and self.SNAP_BATCHES) else 0
+        cap = min(1.0, cfg.capacity_factor / cfg.world_size)
+        if cap * cfg.world_size < 1.0:
+            return  # infeasible cap (capacity_factor < 1): nothing to match
+        batches = self._share_predictor.predict_batches(
+            cfg.batch_size, bucket=bucket, max_share=cap
+        )
+        if batches is None:
+            return
+        # epoch index only seeds the plan's permutation; shapes are epoch-free
+        pred = self._build_plan(0, batches)
+        topo = self.topology
+        d0 = topo.used_device_indices[0]
+        group = topo.groups[d0]
+        padded = [pred.workers[self.rank_lo + r].padded_batch for r in group]
+        for s0, s1 in self._elastic_ranges(pred.num_steps):
+            self._aot_submit_superstep(padded, s1 - s0, speculative=True)
 
     def _aot_speculate(self, plan, wins) -> None:
         cfg = self.cfg
@@ -1004,11 +1203,26 @@ class Trainer:
             self.cfg.trace_dir,
             self.cfg.base_filename().format(self.proc_id) + ".trace.json",
         )
+        # process-backend compile workers buffer their own spans and write
+        # them at exit: flush (shut down) the worker pool first, then stitch
+        # the files into the run trace as pid-tagged tracks
+        worker_traces = []
+        if self._aot is not None:
+            worker_traces = self._aot.flush_workers()
         self._trace.save(path)
+        if worker_traces:
+            from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
+                merge_trace_files,
+            )
+
+            merge_trace_files(path, worker_traces)
         self.logger.info(
             f"graftscope trace saved: {path} "
-            f"({len(self._trace.events())} events; `graftscope summarize` "
-            "for the per-phase epoch-attribution table)"
+            f"({len(self._trace.events())} events"
+            + (f"; stitched {len(worker_traces)} compile-worker trace files"
+               if worker_traces else "")
+            + "; `graftscope summarize` for the per-phase epoch-attribution "
+            "table)"
         )
         return path
 
@@ -1097,6 +1311,10 @@ class Trainer:
                     batch_sizes, cfg.bucket, cfg.batch_size
                 )
                 self.shares = batch_sizes.astype(np.float64) / batch_sizes.sum()
+            # feed the trajectory predictor the REALIZED (post-quantization)
+            # shares — the quantity whose next value implies the next epoch's
+            # dispatched shape tuple (scan-mode speculation)
+            self._share_predictor.observe(self.shares)
             self.logger.info(
                 f"Epoch {epoch}: adjusted shares to {np.round(self.shares, 4).tolist()}"
             )
@@ -1755,6 +1973,11 @@ class Trainer:
                 ],
             )
         seed = jnp.int32(cfg.seed * 31 + epoch)
+        if self.n_proc == 1:
+            # committed replicated, matching the AOT lowering spec — an
+            # uncommitted scalar would call the compiled executable with a
+            # mismatched input sharding
+            seed = jax.device_put(seed, replicated_sharding(mesh))
 
         # Streaming: gather window k+1 on the prefetch thread while the device
         # runs window k (dispatch is async — the jit call returns immediately).
@@ -1783,20 +2006,28 @@ class Trainer:
                         use_cache, pack_total,
                     )
                 with self._trace.span("fused_dispatch", cat="dispatch"):
+                    # service-registry resolution (multi-device AOT lowering):
+                    # warm-started runs dispatch the pre-compiled executable;
+                    # cold keys compile inline through the service (same wall,
+                    # registered + sentinel-silent); multi-host stays lazy
                     if use_cache:
                         idxs, ws_ = win
-                        self.state, metrics = self.steps.fused_epoch_idx(
-                            self.state, cache_x, cache_y, idxs, ws_, slow, seed
+                        args = (self.state, cache_x, cache_y, idxs, ws_, slow, seed)
+                        fn = self._resolve_fused_epoch(
+                            idxs.shape[0], idxs.shape[1], slow.shape[0], args
                         )
+                        self.state, metrics = fn(*args)
                     else:
                         xs, ys, ws_ = win
                         if first_window is None and self._fused_sync_per_step is None:
                             # retained only on the run's first epoch, for the
                             # one-time sync/FLOPs probes below — not pinned later
                             first_window = (xs, ys, ws_)
-                        self.state, metrics = self.steps.fused_epoch(
-                            self.state, xs, ys, ws_, slow, seed
+                        args = (self.state, xs, ys, ws_, slow, seed)
+                        fn = self._resolve_fused_epoch(
+                            xs.shape[0], xs.shape[1], slow.shape[0], args
                         )
+                        self.state, metrics = fn(*args)
                     metrics_total += np.asarray(jax.block_until_ready(metrics))
                 heartbeat()
         metrics = metrics_total
@@ -2084,6 +2315,7 @@ class Trainer:
                     self._aot_resolve("worker_first" + suffix, b, d, wl, step_first),
                     self._aot_resolve("worker_acc" + suffix, b, d, wl, step_acc),
                 )
+        combine = self._aot_resolve_combine("combine_update", steps.combine_update)
         for s in range(win):
             s_i = np.int32(s)
             with self._host_meter.dispatch():
@@ -2110,7 +2342,7 @@ class Trainer:
                 stacked = stack_partials(
                     [partials[d] for d in topo.used_device_indices], self.mesh
                 )
-                self.state = self.steps.combine_update(self.state, stacked)
+                self.state = combine(self.state, stacked)
 
     def _train_epoch_elastic(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:
         cfg = self.cfg
@@ -2533,10 +2765,15 @@ class Trainer:
         stacked = stack_partials(
             [partials[d] for d in topo.used_device_indices], self.mesh
         )
-        # warm (compile) untimed, then time the pure collective+update
-        jax.block_until_ready(self.steps.combine_probe(self.state, stacked).params)
+        # warm (compile) untimed, then time the pure collective+update; the
+        # combine twin resolves from the AOT registry (warm-submitted) so the
+        # warm call is a dispatch, not a lazy compile
+        combine_probe = self._aot_resolve_combine(
+            "combine_probe", self.steps.combine_probe
+        )
+        jax.block_until_ready(combine_probe(self.state, stacked).params)
         t0 = time.perf_counter()
-        probed = self.steps.combine_probe(self.state, stacked)
+        probed = combine_probe(self.state, stacked)
         jax.block_until_ready(probed.params)
         return time.perf_counter() - t0
 

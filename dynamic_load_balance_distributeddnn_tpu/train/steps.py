@@ -423,10 +423,15 @@ class StepLibrary:
 
     # ------------------------------------------------------- AOT lowerables
     # The executable families the async compile service can pre-compile,
-    # keyed by the names the engine uses in its service keys. Fused-path
-    # executables are deliberately absent: they compile once per run on a
-    # single shape and gain nothing from the ladder treatment (the fused
-    # sync/FLOPs probes go through service.compile_now with concrete args).
+    # keyed by the names the engine uses in its service keys. Since ISSUE 5
+    # the MESH-sharded programs are included too: the fused whole-epoch
+    # scans (``fused_epoch``/``fused_epoch_idx``) and the combine twins
+    # lower from ShapeDtypeStructs carrying explicit NamedShardings, so
+    # warm-start AOT-submits them instead of paying their compile lazily
+    # inside the excluded epoch 0 (the PR-3 single-host-probe gate, lifted).
+    # Only the fused sync/FLOPs PROBES stay compile_now-with-concrete-args
+    # (their input shardings derive from window indexing and are easiest to
+    # match from the live arrays).
 
     def aot_lowerables(self) -> Dict[str, Callable]:
         return {
@@ -440,6 +445,10 @@ class StepLibrary:
             "worker_acc_win_idx": self.worker_step_acc_win_idx,
             "group_superstep": self.group_superstep,
             "group_superstep_idx": self.group_superstep_idx,
+            "fused_epoch": self.fused_epoch,
+            "fused_epoch_idx": self.fused_epoch_idx,
+            "combine_update": self.combine_update,
+            "combine_probe": self.combine_probe,
         }
 
     # ------------------------------------------------------------ fused path

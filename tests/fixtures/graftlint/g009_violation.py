@@ -1,0 +1,39 @@
+"""Seeded G009 violations: hot-path dispatch/compile bypassing the AOT
+service registry.
+
+Pattern A: a dispatch hot scope calling a StepLibrary executable (or a
+jit-bound module callable) directly — the warm/speculative compiles sitting
+in the ``AOTCompileService`` registry are never consulted, so a shape
+already compiled in the background recompiles lazily in the foreground.
+
+Pattern B: a direct ``fn.lower(args)`` / ``lowered.compile()`` outside the
+service — the executable never registers for reuse and the compile is
+invisible to the service's dedup/stats.
+"""
+
+import jax
+
+from dynamic_load_balance_distributeddnn_tpu.runtime.compiler import (
+    AOTCompileService,
+)
+
+hot_step = jax.jit(lambda p, x: (p * x).sum())
+
+
+class MiniEngine:
+    def __init__(self, steps):
+        self.steps = steps
+        self._aot = AOTCompileService()
+
+    def _dispatch_combine_steps(self, state, stacked):
+        # G009: direct StepLibrary dispatch in the steady-state hot loop
+        return self.steps.combine_update(state, stacked)
+
+    def run_epoch(self, params, x):
+        # G009: jit-bound module callable dispatched around the registry
+        return hot_step(params, x)
+
+    def _stage_plan(self, params, x):
+        # G009 x2: lowers + compiles outside the service — unregistered
+        lowered = hot_step.lower(params, x)
+        return lowered.compile()

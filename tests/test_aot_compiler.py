@@ -163,8 +163,10 @@ def test_warm_compile_count_bounded_by_ladder(bundle):
     ladder_len = len(range(cfg.bucket, max_b + 1, cfg.bucket))
     n_used = len(tr.topology.used_device_indices)
     assert tr._elastic_mode() == "window"
-    # plain probe executable + one windowed twin per rung per device
-    expected_jobs = n_used * ladder_len * 2
+    # plain probe executable + one windowed twin per rung per device, plus
+    # the two mesh-wide combine twins (warm-submitted since the multi-device
+    # AOT lowering landed — they dispatch every elastic step/probe)
+    expected_jobs = n_used * ladder_len * 2 + 2
     per_job_events = 8  # constants/layout twins ride along with each compile
     with compile_budget(
         max_compiles=per_job_events * expected_jobs,
@@ -203,6 +205,93 @@ def test_rebalance_sentinel_silent_with_speculation(bundle):
     assert sum(compiles[2:]) == 0, compiles
     assert tr._aot.stats()["speculative"] > 0
     assert not any("XLA backend compile" in w for w in warnings_seen), warnings_seen
+
+
+def test_fused_path_sentinel_silent_and_registry_dispatched(bundle):
+    """ISSUE-5 acceptance: the fused multi-device path compiles zero
+    steady-state foreground programs. The mesh-sharded whole-epoch scan
+    (`fused_epoch`/`fused_epoch_idx`) AOT-lowers from ShapeDtypeStructs with
+    explicit shardings at warm-start and dispatches from the service
+    registry — the lazy jit cache stays EMPTY, so the executable provably
+    came from the AOT path, not a lazy fallback."""
+    cfg = _cfg(
+        epoch_size=4,
+        warm_start=True,
+        aot_warm=True,
+        fused_dbs=True,
+        fault_tolerance=True,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.faults import (
+        StaticStragglerInjector,
+    )
+
+    tr = Trainer(
+        cfg,
+        bundle=bundle,
+        injector=StaticStragglerInjector([3.0, 1.0, 1.0, 1.0], mode="virtual"),
+        timing_model=linear_time,
+        log_to_file=False,
+    )
+    rec = tr.run()
+    fused_keys = [
+        k for k in tr._aot.keys() if k[0] in ("fused_epoch", "fused_epoch_idx")
+    ]
+    assert fused_keys, tr._aot.keys()
+    assert all(tr._aot.get(k) is not None for k in fused_keys)
+    # registry dispatch: the lazy twins never compiled
+    scan = (
+        tr.steps.fused_epoch_idx if tr._use_device_cache else tr.steps.fused_epoch
+    )
+    assert scan._cache_size() == 0
+    compiles = rec.data["xla_compiles"]
+    # epoch 0 pays the one-time foreground work; the fused steady state must
+    # be compile-free INCLUDING the mesh program (the PR-3 exclusion, lifted)
+    assert sum(compiles[2:]) == 0, compiles
+    assert np.isfinite(rec.data["train_loss"]).all()
+
+
+def test_scan_speculation_precompiles_predicted_tuple(bundle):
+    """Scan-mode tuple speculation: with `speculate_scan`, the predictor's
+    superstep (shapes, window) keys are background-compiled in the untimed
+    tail, and a rebalancing scan run's steady-state epochs stay
+    foreground-compile-free."""
+    cfg = _cfg(
+        epoch_size=4,
+        warm_start=True,
+        aot_warm=True,
+        aot_speculate=True,
+        speculate_scan=True,
+        superstep="auto",
+        device=0,  # all workers on one device group -> scan mode
+    )
+    tr = Trainer(
+        cfg, bundle=bundle, timing_model=linear_time, log_to_file=False
+    )
+    assert tr._elastic_mode() == "scan"
+    rec = tr.run()
+    parts = np.asarray(rec.data["partition"])
+    assert not np.allclose(parts[-1], parts[0])  # it rebalanced
+    compiles = rec.data["xla_compiles"]
+    assert sum(compiles[2:]) == 0, compiles
+    # The converged run above predicts the tuple it already dispatches —
+    # every speculation dedups to a lookup (the cheap steady state). Drive
+    # the predictor onto a MOVING trajectory and check the wiring: the
+    # predicted (unseen) tuple is queued speculatively.
+    calls = []
+    tr._aot_submit_superstep = (
+        lambda padded, win, speculative=False: calls.append(
+            (tuple(padded), int(win), speculative)
+        )
+        or []
+    )
+    tr._share_predictor.observe(np.array([0.25, 0.25, 0.25, 0.25]))
+    tr._share_predictor.observe(np.array([0.375, 0.2083, 0.2084, 0.2083]))
+    tr._speculate_scan_tuple()
+    assert calls, "moving trajectory must queue the predicted tuple"
+    assert all(spec for _, _, spec in calls)
+    # velocity extrapolation: worker 0's padded batch keeps growing past
+    # its last realized rung
+    assert calls[0][0][0] > 0.375 * 64
 
 
 def test_aot_off_keeps_legacy_warm(bundle):

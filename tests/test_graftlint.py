@@ -37,6 +37,7 @@ def codes(findings):
         ("g006_violation.py", "G006", 1),
         ("g007_violation.py", "G007", 2),  # execute-warm loop + timed compile
         ("g008_violation.py", "G008", 2),  # recorded series + meta write
+        ("g009_violation.py", "G009", 4),  # steps + jit dispatch, lower, compile
     ],
 )
 def test_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -198,6 +199,65 @@ def test_g008_transitive_flow_through_extras_dict_trips():
         "    recorder.record_epoch(epoch=0, **extras)\n"
     )
     assert codes(lint_source(src)) == {"G008"}
+
+
+def test_g009_registry_resolution_is_quiet():
+    """The sanctioned engine pattern: resolve the executable from the AOT
+    service (steps attr only as the uncalled fallback, or the lazy jit only
+    bound on a registry miss), then dispatch the resolved handle."""
+    src = (
+        "class Engine:\n"
+        "    def __init__(self, steps, svc):\n"
+        "        self.steps = steps\n"
+        "        self._aot = svc\n"
+        "    def _dispatch_combine_steps(self, state, stacked):\n"
+        "        combine = self._aot_resolve_combine(\n"
+        "            'combine_update', self.steps.combine_update)\n"
+        "        return combine(state, stacked)\n"
+        "    def _dispatch_superstep_window(self, state, cols, key):\n"
+        "        fn = None\n"
+        "        if self._aot is not None:\n"
+        "            fn = self._aot.get(key)\n"
+        "        if fn is None:\n"
+        "            fn = self.steps.group_superstep\n"
+        "        return fn(state, *cols)\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_g009_needs_a_registry_in_scope():
+    """A module with no AOT service handle has no registry to bypass —
+    direct jit dispatch there is just dispatch (lm/sp engines, fixtures)."""
+    src = (
+        "import jax\n"
+        "hot_step = jax.jit(lambda p, x: (p * x).sum())\n"
+        "def run_epoch(params, x):\n"
+        "    return hot_step(params, x)\n"
+    )
+    assert lint_source(src) == []
+    gated = src.replace(
+        "import jax\n",
+        "import jax\nfrom dynamic_load_balance_distributeddnn_tpu.runtime"
+        ".compiler import AOTCompileService\n",
+    )
+    assert codes(lint_source(gated)) == {"G009"}
+
+
+def test_g009_warm_and_probe_scopes_are_quiet():
+    """Warm scopes (the sanctioned serial A/B reference) and probes are not
+    steady-state dispatch paths — G009 stays out of G007's jurisdiction."""
+    src = (
+        "class Engine:\n"
+        "    def __init__(self, steps, svc):\n"
+        "        self.steps = steps\n"
+        "        self._aot = svc\n"
+        "    def _warm_superstep_shapes(self, dummy, tup, slows):\n"
+        "        _, aux = self.steps.group_superstep(dummy, *tup, slows)\n"
+        "        return aux\n"
+        "    def _probe_workers(self, state, xb, yb):\n"
+        "        return self.steps.worker_step_first(state, xb, yb)\n"
+    )
+    assert lint_source(src) == []
 
 
 # ------------------------------------------------------------ rule mechanics

@@ -140,7 +140,11 @@ def test_chrome_trace_json_schema(tmp_path):
     path = tr.save(str(tmp_path / "t.trace.json"))
     with open(path) as f:
         payload = json.load(f)
-    assert set(payload) == {"traceEvents", "displayTimeUnit"}
+    # extra top-level keys are legal Chrome-trace metadata: `graftscope`
+    # carries the unix twin of the perf_counter base so cross-process
+    # stitching (merge_trace_files) can realign compile-worker timelines
+    assert set(payload) == {"traceEvents", "displayTimeUnit", "graftscope"}
+    assert isinstance(payload["graftscope"]["base_unix"], float)
     events = payload["traceEvents"]
     assert events, "trace must not be empty"
     for ev in events:

@@ -83,7 +83,13 @@ def test_packed_dbs_off_single_device(bundle):
     """dbs-off single-chip runs also take the packed scan (uniform plan)."""
     tr, rec = _run(bundle, packed="auto", dbs=False)
     assert np.isfinite(rec.data["train_loss"]).all()
-    assert tr.steps.fused_epoch_idx._cache_size() >= 1
+    # the packed scan ran: since the multi-device AOT lowering, the engine
+    # dispatches the service-registered executable (lazy jit cache stays
+    # empty); a lazy-cache entry means the fallback path ran instead
+    assert tr.steps.fused_epoch_idx._cache_size() >= 1 or (
+        tr._aot is not None
+        and any(k[0] == "fused_epoch_idx" for k in tr._aot.keys())
+    )
 
 
 @pytest.mark.slow

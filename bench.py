@@ -43,6 +43,11 @@ init can block 50+ minutes and then fail UNAVAILABLE):
    in-process thread pool (`compile_workers_ab` field: the same eight
    resnet18 worker-step programs, equal compile counts, thread leg first
    on a disabled persistent cache; ISSUE 5, BENCH_WORKERS_AB=0 disables).
+8. ELASTIC RECOVERY A/B — the CPU tier kills 1 of ws workers mid-run via
+   the PreemptionInjector and measures detection-to-resumed-training time
+   plus the post-recovery steady epoch wall vs a fresh run started at the
+   reduced world size (`elastic_recovery_ab` field; ISSUE 6,
+   BENCH_ELASTIC_AB=0 disables).
 
 Instrumentation: examples/s and MFU (obs/flops.py, XLA cost model vs chip
 bf16 peak) from the trainer's recorder extras, reported in `detail`.
@@ -613,6 +618,108 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
                     os.unlink(ab_path)
                 except OSError:
                     pass
+        _write_atomic(out_path, out)
+
+    if (
+        force_cpu
+        and os.environ.get("BENCH_ELASTIC_AB", "1") == "1"
+        and "elastic_recovery_ab" not in out["instr"]
+    ):
+        if resume.get("instr", {}).get("elastic_recovery_ab"):
+            out["instr"]["elastic_recovery_ab"] = resume["instr"][
+                "elastic_recovery_ab"
+            ]
+        else:
+            # Elastic recovery A/B (ISSUE 6 acceptance): a chaos leg — the
+            # PreemptionInjector kills 1 of ws workers mid-epoch 1, the
+            # engine detects at a window boundary, re-solves over the
+            # survivors, and keeps training — vs a fresh run STARTED at the
+            # reduced world size. Reported: detection-to-resumed-training
+            # time, the post-recovery steady epoch wall vs the fresh
+            # reduced-fleet wall (ratio ~1 = no poisoned state, no lingering
+            # tax), and the post-recovery foreground-compile sentinel (the
+            # re-solve re-warms the new world size through the AOT service;
+            # steady epochs must stay compile-silent).
+            from dynamic_load_balance_distributeddnn_tpu.faults import (
+                PreemptionEvent,
+                PreemptionInjector,
+            )
+
+            ab = {}
+            n_el = max(int(os.environ.get("BENCH_ELASTIC_AB_EPOCHS", 5)), 4)
+            kill = ws - 1
+            cfg = Config(
+                debug=False,
+                world_size=ws,
+                batch_size=batch,
+                learning_rate=0.01,
+                epoch_size=n_el,
+                dataset=dataset,
+                model=model,
+                dynamic_batch_size=True,
+                fault_tolerance=False,
+                bucket=bucket,
+                precision=precision,
+                elastic="on",
+                warm_start=True,
+                # several windows per epoch so the kill is detected
+                # MID-epoch (the elastic path checks liveness at window
+                # boundaries), not at the next epoch's boundary check
+                stream_chunk_steps=1,
+            )
+            inj = PreemptionInjector(
+                ws,
+                [PreemptionEvent(worker=kill, down_at=1.4, rejoin_epoch=None)],
+            )
+            tr = Trainer(cfg, bundle=bundle, injector=inj, log_to_file=False)
+            walls = [
+                round(tr._run_epoch_elastic_world(e)["epoch_wall"], 4)
+                for e in range(n_el)
+            ]
+            events = tr.recorder.meta.get("elastic_events") or []
+            rec_ev = next((e for e in events if "lost" in e), None)
+            if rec_ev is not None and tr.world_size == ws - 1:
+                ab["killed_worker"] = kill
+                ab["detected_epoch"] = rec_ev["epoch"]  # 1 = within the
+                # epoch the kill landed in (detection-to-resume <= 1 epoch)
+                ab["detect_to_resume_s"] = rec_ev["detect_to_resume_s"]
+                ab["chaos_walls_s"] = walls
+                # steady post-recovery window: the recovery epoch re-runs
+                # (and pays the new world size's plan), the NEXT epochs are
+                # the survivors' steady state
+                post = walls[rec_ev["epoch"] + 1:]
+                if post:
+                    ab["post_recovery_wall_s"] = round(min(post), 4)
+                xc = tr.recorder.data.get("xla_compiles") or []
+                ab["post_recovery_fg_compiles"] = [
+                    int(v) for v in xc[rec_ev["epoch"] + 1:]
+                ]
+
+                # the comparison leg keeps elastic ON (no injector): both
+                # legs pay the standing elasticity cost (epoch snapshot,
+                # health checks), so the ratio isolates recovery RESIDUE —
+                # poisoned state or lingering tax — not the cost of
+                # elasticity itself
+                cfg2 = cfg.replace(world_size=ws - 1)
+                tr2 = Trainer(cfg2, bundle=bundle, log_to_file=False)
+                walls2 = [
+                    round(tr2._run_epoch_elastic_world(e)["epoch_wall"], 4)
+                    for e in range(n_el)
+                ]
+                ab["reduced_fresh_walls_s"] = walls2
+                ab["reduced_fresh_wall_s"] = round(min(walls2[1:]), 4)
+                if ab.get("post_recovery_wall_s"):
+                    ab["post_vs_reduced_x"] = round(
+                        ab["post_recovery_wall_s"] / ab["reduced_fresh_wall_s"],
+                        3,
+                    )
+            else:
+                ab["error"] = (
+                    f"recovery did not complete (events={len(events)}, "
+                    f"world_size={tr.world_size})"
+                )
+                sys.stderr.write(f"[bench] elastic_recovery_ab: {ab['error']}\n")
+            out["instr"]["elastic_recovery_ab"] = ab
         _write_atomic(out_path, out)
     return 0
 

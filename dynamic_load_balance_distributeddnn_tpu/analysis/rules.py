@@ -1458,6 +1458,133 @@ class RuleG009:
         yield from self._check_unregistered_compiles(ctx)
 
 
+# --------------------------------------------------------------------------
+# G010 — unguarded blocking device calls in elastic retry/recovery scopes
+
+
+class RuleG010:
+    code = "G010"
+    summary = (
+        "blocking device-side call in a retry/recovery scope without "
+        "heartbeat() coverage or a retry/timeout wrapper"
+    )
+    fix_hint = (
+        "recovery scopes run exactly when the fleet is misbehaving — a "
+        "blocking PJRT call (block_until_ready/device_put/device_get/"
+        ".compile()) there can hang in C++ against a dead runtime, and "
+        "without a heartbeat() the stall watchdog reads the recovery itself "
+        "as the hang. Call heartbeat() after each blocking edge in the "
+        "scope, or wrap the edge in retry_transient(..., tick=heartbeat)"
+    )
+
+    # The rule only makes sense where the elasticity machinery EXISTS:
+    # modules that name the health/recovery surface. Token match (not
+    # docstrings) keeps unrelated modules — and the other lint fixtures —
+    # out of scope.
+    _GATE_NAMES = {"WorkerLost", "WorkerHealth", "retry_transient"}
+    # Recovery scopes by naming convention (mirrors G009's dispatch-scope
+    # convention): the engine's failure-detection -> drain -> re-solve ->
+    # re-shard -> readmit path.
+    _SCOPE_MARKERS = ("recover", "readmit", "reshard")
+    # Blocking device-side call tails.
+    _BLOCKING_TAILS = {
+        "block_until_ready",
+        "device_put",
+        "device_get",
+    }
+
+    def _module_gated(self, ctx) -> bool:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name) and node.id in self._GATE_NAMES:
+                return True
+            if isinstance(node, ast.Attribute) and node.attr in self._GATE_NAMES:
+                return True
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                if any(
+                    (a.asname or a.name).split(".")[-1] in self._GATE_NAMES
+                    for a in node.names
+                ):
+                    return True
+        return False
+
+    def _is_recovery_scope(self, fn: ast.AST) -> bool:
+        if isinstance(fn, ast.Lambda):
+            return False
+        name = fn.name.lower()
+        if name == "retry_transient":
+            return False  # the wrapper itself is the sanctioned armor
+        return any(m in name for m in self._SCOPE_MARKERS)
+
+    @staticmethod
+    def _is_blocking(node: ast.Call, tails) -> bool:
+        if isinstance(node.func, ast.Attribute) and node.func.attr in tails:
+            return True
+        # lowered.compile() / jit(f).lower(...).compile(): a blocking XLA
+        # backend compile (re-warm edges of a re-shard)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "compile"
+            and not node.args
+            and not node.keywords
+        ):
+            return True
+        name = call_name(node)
+        return bool(name) and _attr_tail(name) in tails
+
+    @staticmethod
+    def _covered(fn: ast.AST) -> bool:
+        """heartbeat() anywhere in the scope keeps the watchdog fed across
+        its blocking edges."""
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                tail = _attr_tail(call_name(n))
+                if tail == "heartbeat":
+                    return True
+        return False
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        if not self._module_gated(ctx):
+            return
+        for fn in [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            if not self._is_recovery_scope(fn):
+                continue
+            if self._covered(fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # calls inside a retry_transient(...) argument are armored
+                # by the wrapper's tick/backoff
+                p = ctx.parents.get(node)
+                armored = False
+                while p is not None and p is not fn:
+                    if (
+                        isinstance(p, ast.Call)
+                        and _attr_tail(call_name(p)) == "retry_transient"
+                    ):
+                        armored = True
+                        break
+                    p = ctx.parents.get(p)
+                if armored:
+                    continue
+                if self._is_blocking(node, self._BLOCKING_TAILS):
+                    yield _finding(
+                        self.code,
+                        ctx,
+                        node,
+                        f"recovery scope `{fn.name}` blocks on the device "
+                        f"(`{call_name(node) or node.func.attr}`) with no "
+                        "heartbeat() in scope and no retry/timeout wrapper "
+                        "— a hang here reads as a watchdog stall of the "
+                        "recovery itself",
+                        self.fix_hint,
+                    )
+
+
 # G007 reuses G002's timed-window extraction; share one instance.
 RULES_G002_WINDOWS = RuleG002()
 
@@ -1473,5 +1600,6 @@ RULES: Dict[str, object] = {
         RuleG007(),
         RuleG008(),
         RuleG009(),
+        RuleG010(),
     )
 }

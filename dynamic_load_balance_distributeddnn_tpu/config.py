@@ -353,6 +353,44 @@ class Config:
                                        # jax.profiler.TraceAnnotation so host
                                        # spans line up with device timelines
                                        # inside a --profile_dir trace
+    elastic: str = "off"               # "on"|"off": elastic world size
+                                       # (ISSUE 6). on: a per-worker health
+                                       # monitor (runtime/health.py) feeds
+                                       # the engine's recovery path — a
+                                       # CONFIRMED-lost worker is dropped,
+                                       # the partition re-solved over
+                                       # survivors (the same solver code
+                                       # path as a straggler re-route),
+                                       # data re-sharded, executables for
+                                       # the new world size warmed through
+                                       # the AOT service, and training
+                                       # continues from the epoch-start
+                                       # consistent snapshot; a recovered
+                                       # worker is readmitted at the next
+                                       # epoch boundary with a probe-seeded
+                                       # share. Costs one host snapshot of
+                                       # the TrainState per epoch while on.
+                                       # Single-process recovery only
+                                       # (multi-host runs get detection +
+                                       # a diagnosable abort; see README
+                                       # "Fault tolerance").
+    elastic_detect_misses: int = 2     # consecutive missed liveness checks
+                                       # that CONFIRM a worker loss (1 miss
+                                       # is indistinguishable from jitter —
+                                       # same two-strike hysteresis as the
+                                       # adaptive probe scheduler)
+    elastic_latency_factor: float = 8.0  # probe latency over this multiple
+                                       # of the fleet median marks a worker
+                                       # SUSPECT (observability; the solver
+                                       # already re-routes data away)
+    elastic_readmit: str = "epoch"     # "epoch": recovered workers rejoin
+                                       # at the next epoch boundary with a
+                                       # probe-seeded share; "off": once
+                                       # lost, a worker stays out (strictly
+                                       # shrinking fleet)
+    elastic_max_recoveries: int = 8    # recovery attempts before the run
+                                       # gives up (a fleet losing workers
+                                       # faster than this is not a fleet)
     packed: str = "auto"               # "auto"|"on"|"off": single-device
                                        # packed epochs — when every worker
                                        # lives on ONE chip (the contention
@@ -392,6 +430,18 @@ class Config:
             raise ValueError("packed must be 'auto', 'on' or 'off'")
         if self.superstep not in ("auto", "on", "off"):
             raise ValueError("superstep must be 'auto', 'on' or 'off'")
+        if self.elastic not in ("on", "off"):
+            raise ValueError("elastic must be 'on' or 'off'")
+        if self.elastic_detect_misses < 1:
+            raise ValueError("elastic_detect_misses must be >= 1")
+        if self.elastic_readmit not in ("epoch", "off"):
+            raise ValueError("elastic_readmit must be 'epoch' or 'off'")
+        if self.elastic == "on" and self.shard_update:
+            raise ValueError(
+                "elastic world size re-places a REPLICATED state across a "
+                "changed mesh; shard_update's mesh-sharded optimizer leaves "
+                "cannot survive a re-shard yet"
+            )
         if self.trace not in ("on", "off", "ring"):
             raise ValueError("trace must be 'on', 'off' or 'ring'")
         if self.trace_ring < 1:
@@ -600,6 +650,27 @@ def get_parser() -> argparse.ArgumentParser:
                    help="Bridge spans into jax.profiler.TraceAnnotation so "
                         "host phases line up with device timelines in a "
                         "--profile_dir trace.")
+    p.add_argument("--elastic", type=str, default=d.elastic,
+                   choices=["on", "off"],
+                   help="Elastic world size: survive confirmed worker loss "
+                        "by re-solving the partition over survivors "
+                        "(re-shard + AOT re-warm + continue from the "
+                        "epoch-start snapshot); readmit recovered workers "
+                        "at epoch boundaries.")
+    p.add_argument("--elastic_detect_misses", type=int,
+                   default=d.elastic_detect_misses,
+                   help="Consecutive missed liveness checks that confirm a "
+                        "worker loss.")
+    p.add_argument("--elastic_latency_factor", type=float,
+                   default=d.elastic_latency_factor,
+                   help="Probe latency over this multiple of the fleet "
+                        "median marks a worker SUSPECT.")
+    p.add_argument("--elastic_readmit", type=str, default=d.elastic_readmit,
+                   choices=["epoch", "off"],
+                   help="Readmission policy for recovered workers: at the "
+                        "next epoch boundary (probe-seeded share), or never.")
+    p.add_argument("--elastic_max_recoveries", type=int,
+                   default=d.elastic_max_recoveries)
     p.add_argument("--packed", type=str, default=d.packed,
                    choices=["auto", "on", "off"],
                    help="Single-device packed epochs: concat all workers' "

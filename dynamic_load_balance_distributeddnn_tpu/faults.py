@@ -21,15 +21,32 @@ Two delivery modes (config.fault_mode):
 ``StaticStragglerInjector`` provides the induced *profile* version — e.g. the
 README recipe's 3:1 contention (`-gpu 0,0,0,1`, README.md:28) expressed as
 per-worker slowdown factors — used for A/B benchmarking.
+
+``PreemptionInjector`` (ISSUE 6) extends the fault model past stragglers to
+*worker loss*: kill/suspend/rejoin schedules, delivered either virtually (the
+engine's health checks see the worker as down — the elastic recovery path's
+test harness) or for real (signals to attached OS processes — the multi-host
+chaos harness). Fault schedules are reproducible per ``--seed``: every
+injector draws from explicit seeded generators (:func:`seeded_rngs`), never
+the module-global ``random`` state, so a recovery test replays bit-for-bit.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import random
-from typing import Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set
 
 import numpy as np
+
+
+def seeded_rngs(seed: int, n: int) -> List[random.Random]:
+    """One independent seeded ``random.Random`` stream per worker (the
+    reference's worker processes each use the global ``random`` unseeded —
+    independent but irreproducible; these are independent AND replayable).
+    The ``seed * 977 + r`` derivation is load-bearing: it is the historical
+    stream layout, so existing seeded schedules stay bit-identical."""
+    return [random.Random(seed * 977 + r) for r in range(n)]
 
 
 @dataclasses.dataclass
@@ -78,14 +95,18 @@ class LuckyFaultInjector(FaultInjector):
         mode: str = "virtual",
         seed: int = 0,
         logger=None,
+        rngs: Optional[Sequence[random.Random]] = None,
     ):
         self.ws = world_size
         self.chance = chance
         self.mode = mode
         self.logger = logger
         # The reference's worker processes use the global `random` unseeded —
-        # independent streams per worker. Here: one seeded stream per worker.
-        self._rngs = [random.Random(seed * 977 + r) for r in range(world_size)]
+        # independent streams per worker. Here: one seeded stream per worker,
+        # injectable (``rngs``) so chaos tests can share/replay one schedule.
+        if rngs is not None and len(rngs) != world_size:
+            raise ValueError("rngs must provide one stream per worker")
+        self._rngs = list(rngs) if rngs is not None else seeded_rngs(seed, world_size)
         self._waiting = [False] * world_size
         self._until = [0] * world_size
         self._wait_s = [0] * world_size
@@ -146,3 +167,203 @@ class StaticStragglerInjector(FaultInjector):
                 np.round(extra_s_per_step / ctx.iter_cost_s), 0
             ).astype(np.int64)
         return out
+
+
+@dataclasses.dataclass(frozen=True)
+class PreemptionEvent:
+    """One scheduled worker outage.
+
+    ``down_at`` is in fractional epoch-time (1.5 = halfway through epoch 1),
+    so outages land MID-epoch — the case the elastic recovery path must
+    survive, not just the tidy boundary one. ``rejoin_epoch`` is the epoch
+    BOUNDARY at which the worker offers to come back (readmission is
+    boundary-only by design: plans are immutable within an epoch); None
+    means it never returns. ``kind`` distinguishes a preemption that loses
+    the process ("kill") from one that freezes it ("suspend") — virtually
+    identical (the worker is unreachable either way), but real-process
+    delivery sends SIGKILL vs SIGSTOP/SIGCONT."""
+
+    worker: int
+    down_at: float
+    rejoin_epoch: Optional[int] = None
+    kind: str = "kill"
+
+    def __post_init__(self):
+        if self.kind not in ("kill", "suspend"):
+            raise ValueError("kind must be 'kill' or 'suspend'")
+        if self.rejoin_epoch is not None and self.rejoin_epoch <= self.down_at:
+            raise ValueError("rejoin_epoch must be after down_at")
+
+
+class PreemptionInjector(FaultInjector):
+    """Kill/suspend/rejoin schedules — the preemptible-fleet fault model.
+
+    Two delivery modes, mirroring the straggler injectors' virtual/compute
+    split:
+
+    * **virtual** (default): the engine's health checks ask
+      :meth:`down_workers` and see the scheduled workers as unreachable —
+      deterministic, cheap, exactly what the recovery-path tests drive.
+    * **real**: :meth:`attach_process` binds a worker to a live OS pid and
+      :meth:`deliver` sends the due signals (SIGKILL for "kill", SIGSTOP /
+      SIGCONT around a "suspend") — the multi-host chaos harness
+      (tests/_mh_worker.py) preempts REAL worker processes with it.
+
+    Schedules are either explicit (``schedule=[PreemptionEvent(...)]``) or
+    drawn per epoch from ``chance`` using an explicit seeded generator —
+    never module-global ``random`` — so a given ``--seed`` replays the same
+    outages (the chaos round-trip tests are deterministic).
+
+    ``base`` optionally composes a straggler injector underneath: a fleet
+    can be slow AND losing workers; ``epoch_faults`` delegates to it, with
+    downed workers' injected load zeroed (a dead worker injects nothing).
+    """
+
+    def __init__(
+        self,
+        world_size: int,
+        schedule: Sequence[PreemptionEvent] = (),
+        *,
+        chance: float = 0.0,
+        max_down_epochs: int = 3,
+        seed: int = 0,
+        rng: Optional[random.Random] = None,
+        base: Optional[FaultInjector] = None,
+        logger=None,
+    ):
+        self.ws = int(world_size)
+        for ev in schedule:
+            if not 0 <= ev.worker < world_size:
+                raise ValueError(f"event worker {ev.worker} out of range")
+        self._events: List[PreemptionEvent] = sorted(
+            schedule, key=lambda e: e.down_at
+        )
+        self.chance = float(chance)
+        self.max_down_epochs = int(max_down_epochs)
+        self._rng = rng if rng is not None else random.Random(seed * 6151 + 17)
+        self.base = base
+        self.logger = logger
+        self._rolled_epochs: Set[int] = set()
+        self._pids: Dict[int, int] = {}
+        self._delivered: Set[tuple] = set()
+
+    # ------------------------------------------------------------- schedule
+
+    def _roll(self, epoch: int) -> None:
+        """Random mode: draw this epoch's outages once (idempotent — the
+        engine may re-run an epoch after a recovery; the schedule must not
+        re-roll or the retry would chase fresh faults forever)."""
+        if self.chance <= 0.0 or epoch in self._rolled_epochs:
+            return
+        self._rolled_epochs.add(epoch)
+        down_now = self.down_workers(epoch + 1.0)
+        for r in range(self.ws):
+            if r in down_now:
+                continue
+            if self._rng.random() < self.chance:
+                ev = PreemptionEvent(
+                    worker=r,
+                    down_at=epoch + self._rng.random(),
+                    rejoin_epoch=epoch + 1 + self._rng.randint(
+                        1, self.max_down_epochs
+                    ),
+                    kind="kill" if self._rng.random() < 0.5 else "suspend",
+                )
+                self._events.append(ev)
+                if self.logger:
+                    self.logger.info(
+                        f"preemption scheduled: worker {ev.worker} "
+                        f"{ev.kind} at t={ev.down_at:.2f}, rejoin at "
+                        f"epoch {ev.rejoin_epoch}"
+                    )
+
+    def schedule(self) -> List[PreemptionEvent]:
+        return list(self._events)
+
+    def down_workers(self, t: float) -> Set[int]:
+        """Workers scheduled down at epoch-time ``t`` (``down_at <= t`` and
+        not yet past their rejoin boundary)."""
+        out: Set[int] = set()
+        for ev in self._events:
+            if ev.down_at <= t and (
+                ev.rejoin_epoch is None or t < ev.rejoin_epoch
+            ):
+                out.add(ev.worker)
+        return out
+
+    def rejoining(self, epoch: int) -> Set[int]:
+        """Workers whose rejoin boundary is exactly ``epoch`` (the engine
+        readmits them before planning that epoch)."""
+        return {
+            ev.worker
+            for ev in self._events
+            if ev.rejoin_epoch is not None and ev.rejoin_epoch == epoch
+        }
+
+    # ----------------------------------------------------- injector surface
+
+    def epoch_faults(self, epoch, num_batches, ctx):
+        self._roll(int(epoch))
+        out = (
+            self.base.epoch_faults(epoch, num_batches, ctx)
+            if self.base is not None
+            else EpochFaults.none(self.ws)
+        )
+        # a downed worker injects nothing — its load is GONE, not slow
+        for r in self.down_workers(float(epoch) + 1.0):
+            if r < len(out.virtual_seconds):
+                out.virtual_seconds[r] = 0.0
+                out.slow_iters_per_step[r] = 0
+                out.time_multipliers[r] = 1.0
+        return out
+
+    # --------------------------------------------------- real-process mode
+
+    def attach_process(self, worker: int, pid: int) -> None:
+        """Bind a worker to a live OS process for real signal delivery."""
+        self._pids[int(worker)] = int(pid)
+
+    def deliver(self, t: float) -> List[tuple]:
+        """Send every signal due by epoch-time ``t`` to attached processes
+        (each edge delivered once): SIGKILL for "kill", SIGSTOP at a
+        "suspend" edge, SIGCONT at its rejoin edge. Returns the delivered
+        ``(worker, signal_name)`` edges — the harness asserts on them."""
+        import signal
+
+        sent: List[tuple] = []
+        for ev in self._events:
+            pid = self._pids.get(ev.worker)
+            if pid is None:
+                continue
+            if ev.down_at <= t:
+                key = (ev.worker, ev.down_at, "down")
+                if key not in self._delivered:
+                    self._delivered.add(key)
+                    sig = signal.SIGKILL if ev.kind == "kill" else signal.SIGSTOP
+                    try:
+                        os_kill(pid, sig)
+                        sent.append((ev.worker, sig.name))
+                    except ProcessLookupError:
+                        pass
+            if (
+                ev.kind == "suspend"
+                and ev.rejoin_epoch is not None
+                and ev.rejoin_epoch <= t
+            ):
+                key = (ev.worker, ev.rejoin_epoch, "rejoin")
+                if key not in self._delivered:
+                    self._delivered.add(key)
+                    try:
+                        os_kill(pid, signal.SIGCONT)
+                        sent.append((ev.worker, "SIGCONT"))
+                    except ProcessLookupError:
+                        pass
+        return sent
+
+
+def os_kill(pid: int, sig) -> None:
+    """``os.kill`` behind a seam the tests can monkeypatch (virtual harness
+    runs must never signal arbitrary pids by accident)."""
+    import os
+
+    os.kill(pid, sig)

@@ -41,13 +41,15 @@ class MetricsRegistry:
         self.host_meter = None  # balance.timing.HostOverheadMeter
         self.compile_tracker = None  # analysis.guards.CompileTracker
         self.aot_service = None  # runtime.compiler.AOTCompileService
+        self.health = None  # runtime.health.WorkerHealth
 
     def attach(self, **surfaces) -> "MetricsRegistry":
         """Register observability surfaces by their well-known slot name
-        (``host_meter``, ``compile_tracker``, ``aot_service``). Unknown
-        names raise — a typo'd attach would silently hollow the snapshot."""
+        (``host_meter``, ``compile_tracker``, ``aot_service``, ``health``).
+        Unknown names raise — a typo'd attach would silently hollow the
+        snapshot."""
         for name, obj in surfaces.items():
-            if name not in ("host_meter", "compile_tracker", "aot_service"):
+            if name not in ("host_meter", "compile_tracker", "aot_service", "health"):
                 raise ValueError(f"unknown registry surface {name!r}")
             setattr(self, name, obj)
         return self
@@ -99,4 +101,6 @@ class MetricsRegistry:
                 k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in self.aot_service.stats().items()
             }
+        if self.health is not None:
+            out["health"] = self.health.snapshot()
         return out

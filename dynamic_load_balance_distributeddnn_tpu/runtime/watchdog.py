@@ -20,6 +20,7 @@ no-op unless ``DBS_HEARTBEAT_FILE`` is set (one getenv + utime when active).
 
 from __future__ import annotations
 
+import faulthandler
 import os
 import sys
 import threading
@@ -28,6 +29,80 @@ import time
 from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
 
 _ENV = "DBS_HEARTBEAT_FILE"
+_EXIT_TAG = "DBS_WATCHDOG_EXIT "
+
+# Extra files the abort path tags alongside its own heartbeat file — the
+# per-process PEER beacon (runtime/health.py) registers here, so a watchdog
+# abort is readable by the peers scanning DBS_PEER_HB_DIR, not just by the
+# parent watching this process's own heartbeat file.
+_EXTRA_TAG_PATHS: set = set()
+
+
+def register_exit_tag_path(path: str) -> None:
+    """Tag ``path`` too when the stall watchdog aborts this process."""
+    _EXTRA_TAG_PATHS.add(path)
+
+
+def unregister_exit_tag_path(path: str) -> None:
+    """Drop a registered tag path (the owning run ended: its beacon file
+    must not be rewritten by a later run's abort)."""
+    _EXTRA_TAG_PATHS.discard(path)
+
+
+def tag_exit_all(hb_path: str, reason: str) -> None:
+    """Tag the watchdog's own heartbeat file AND every registered peer
+    beacon file with the abort reason. Last-breath code: a concurrent
+    register/unregister (a finalizer on another thread) must not raise out
+    of the watchdog thread — that would leave the wedged process it exists
+    to abort hanging forever."""
+    try:
+        paths = {hb_path} | set(tuple(_EXTRA_TAG_PATHS))
+    except RuntimeError:  # set mutated mid-copy: settle for our own file
+        paths = {hb_path}
+    for p in paths:
+        tag_exit_reason(p, reason)
+
+
+def tag_exit_reason(hb_path: str, reason: str) -> None:
+    """Write the abort reason INTO the heartbeat file, so the parent (bench
+    retry loop, multi-host peer scanning the heartbeat dir) can tell a
+    watchdog abort apart from a silent freeze or an OOM kill. The tag
+    replaces the file's (empty) pulse content; the mtime pulse semantics are
+    moot once the process is about to ``os._exit``."""
+    try:
+        with open(hb_path, "w") as f:
+            f.write(f"{_EXIT_TAG}{reason}\n")
+    except OSError:
+        pass
+
+
+def read_exit_reason(hb_path: str):
+    """The exit-reason tag a watchdog left in ``hb_path``, or None (absent
+    file, unreadable file, or a plain pulse file with no tag)."""
+    try:
+        with open(hb_path) as f:
+            head = f.read(4096)
+    except OSError:
+        return None
+    if head.startswith(_EXIT_TAG):
+        return head[len(_EXIT_TAG):].strip()
+    return None
+
+
+def _dump_all_stacks(reason: str) -> None:
+    """Post-mortem for the C++-blocked hang: Python-level stacks of every
+    thread, via faulthandler (safe to call with the GIL held by *this*
+    thread while another is wedged in a PJRT RPC). Lands on stderr, which
+    the run log / parent subprocess captures — the only diagnosable record
+    of WHERE the process was stuck, since ``os._exit`` skips every
+    destructor and atexit hook."""
+    try:
+        sys.stderr.write(f"[watchdog] {reason}; all-thread stacks:\n")
+        sys.stderr.flush()
+        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        sys.stderr.flush()
+    except Exception:  # noqa: BLE001 — last-breath diagnostics must not mask the exit
+        pass
 
 
 def heartbeat() -> None:
@@ -129,10 +204,16 @@ def arm_stall_watchdog(
             last = _newest_mtime()
             threshold = first_grace_s if grace_active else stall_s
             if time.time() - last > threshold:
-                sys.stderr.write(
-                    f"[watchdog] no heartbeat for {threshold:.0f}s "
-                    f"(device RPC hang?); aborting\n"
+                reason = (
+                    f"stall: no heartbeat for {threshold:.0f}s "
+                    "(device RPC hang?)"
                 )
+                # post-mortem first (stderr -> run log), then the tag the
+                # parent reads, then the only reliable abort for a
+                # C++-blocked process
+                _dump_all_stacks(reason)
+                tag_exit_all(hb_path, f"{reason}; exit_code={exit_code}")
+                sys.stderr.write(f"[watchdog] {reason}; aborting\n")
                 sys.stderr.flush()
                 os._exit(exit_code)
 

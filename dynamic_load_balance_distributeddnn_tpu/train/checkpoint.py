@@ -5,35 +5,128 @@ a rank-0 metrics dump plus a log-file idempotence probe. This module is the
 deliberate capability upgrade: orbax-backed checkpoints of the TrainState plus
 a JSON sidecar with the DBS controller state (shares, node_times, wallclock),
 so a resumed run continues balanced exactly where it left off.
+
+Manager lifecycle (ISSUE 6 satellite): one ``CheckpointManager`` is cached
+per ``ckpt_dir`` for the life of the process — the old per-save
+construct → ``wait_until_finished`` → ``close`` cycle paid manager setup AND
+a full blocking drain inside every epoch tail. Saves are now non-blocking
+(orbax commits on its background thread; the epoch tail only enqueues);
+:func:`flush_checkpoints` is the explicit drain, called at run end and
+before any elastic re-shard — the two places a half-committed checkpoint
+could be observed (by the next process, or by a recovery that resumes from
+"the last consistent state").
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
+# One manager per absolute ckpt_dir, process-wide. The lock guards dict
+# access; SEQUENTIAL sharing of a dir (test fixtures, bench retry loops,
+# resume-after-run) is fully safe — a close=True flush evicts the entry and
+# the next _manager() call builds a fresh one. A save racing a concurrent
+# trainer's close on the SAME dir is armored at the save site (evict +
+# retry with a fresh manager), not prevented.
+_MANAGERS: Dict[str, Any] = {}
+_LOCK = threading.Lock()
+
 
 def _manager(ckpt_dir: str):
     import orbax.checkpoint as ocp
 
-    return ocp.CheckpointManager(
-        os.path.abspath(ckpt_dir),
-        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
-    )
+    path = os.path.abspath(ckpt_dir)
+    with _LOCK:
+        mgr = _MANAGERS.get(path)
+        if mgr is None:
+            mgr = ocp.CheckpointManager(
+                path,
+                options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
+            )
+            _MANAGERS[path] = mgr
+    return mgr
 
 
-def save_checkpoint(ckpt_dir: str, epoch: int, state, controller: Dict[str, Any]) -> None:
-    """controller: shares / node_times / total_wallclock (JSON-serializable)."""
+def flush_checkpoints(ckpt_dir: Optional[str] = None, close: bool = False) -> None:
+    """Block until every pending async save under ``ckpt_dir`` (all cached
+    dirs when None) has committed. ``close=True`` additionally closes and
+    evicts the manager(s) — end-of-run hygiene so long-lived processes
+    (test tiers, bench loops) don't accumulate orbax thread pools."""
+    with _LOCK:
+        if ckpt_dir is None:
+            items = list(_MANAGERS.items())
+        else:
+            path = os.path.abspath(ckpt_dir)
+            mgr = _MANAGERS.get(path)
+            items = [(path, mgr)] if mgr is not None else []
+        if close:
+            for path, _ in items:
+                _MANAGERS.pop(path, None)
+    for _, mgr in items:
+        mgr.wait_until_finished()
+        if close:
+            mgr.close()
+
+
+def save_checkpoint(
+    ckpt_dir: str, epoch: int, state, controller: Dict[str, Any],
+    block: bool = False,
+) -> None:
+    """controller: shares / node_times / total_wallclock (JSON-serializable).
+
+    Non-blocking by default: the save is enqueued on the cached manager's
+    async machinery and the call returns (the epoch tail stops paying the
+    serialization wall). Callers that need durability NOW — end of run, the
+    elastic recovery path about to mutate the fleet — pass ``block=True``
+    or call :func:`flush_checkpoints`."""
     import orbax.checkpoint as ocp
 
-    mgr = _manager(ckpt_dir)
-    mgr.save(epoch, args=ocp.args.StandardSave(state))
-    mgr.wait_until_finished()
-    mgr.close()
+    multihost = jax.process_count() > 1
+    if multihost:
+        payload = state
+    else:
+        # Async-safety: the engine's hot-path executables DONATE the state
+        # buffers (steps.py donate_argnums), so an in-flight background save
+        # reading the live jax arrays is a use-after-free once the next step
+        # dispatches. Snapshot to host with a FORCED copy (on the CPU
+        # backend np.asarray can alias the device buffer) and hand orbax the
+        # copy — the epoch tail pays one host memcpy instead of the full
+        # serialize-to-disk wall.
+        payload = jax.tree_util.tree_map(
+            lambda t: np.array(t, copy=True), jax.device_get(state)
+        )
+
+    def _save(mgr) -> None:
+        mgr.save(epoch, args=ocp.args.StandardSave(payload))
+        # multi-host leaves are not fully addressable: orbax must read the
+        # live distributed arrays, so that save stays synchronous (the next
+        # epoch's donating steps would otherwise reuse the buffers under it)
+        if multihost or block:
+            mgr.wait_until_finished()
+
+    try:
+        _save(_manager(ckpt_dir))
+    except Exception:  # noqa: BLE001 — closed-manager race, see _MANAGERS
+        # a concurrent trainer's flush_checkpoints(close=True) on the same
+        # dir can close the cached manager between our fetch and save:
+        # evict the entry, drain-and-close the old manager (its background
+        # commit must not race the retry into the same step dir), and retry
+        # once on a fresh one — a second failure is a real save error and
+        # propagates
+        with _LOCK:
+            old = _MANAGERS.pop(os.path.abspath(ckpt_dir), None)
+        if old is not None:
+            try:
+                old.wait_until_finished()
+                old.close()
+            except Exception:  # noqa: BLE001 — already-closed is the expected case
+                pass
+        _save(_manager(ckpt_dir))
     if jax.process_index() != 0:
         # orbax coordinates the distributed array save across processes; the
         # controller sidecar is replicated host state, written once.
@@ -57,22 +150,37 @@ def restore_checkpoint(
     if not os.path.isdir(ckpt_dir):
         return None
     mgr = _manager(ckpt_dir)
+    # a writer sharing this process (resume-after-loss tests, bench retry
+    # loops) may still be committing — a half-committed latest step must
+    # never be restored
+    mgr.wait_until_finished()
     step = mgr.latest_step()
     if step is None:
-        mgr.close()
         return None
     abstract = jax.tree_util.tree_map(
         ocp.utils.to_shape_dtype_struct, state_template
     )
     state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-    mgr.close()
     # Re-place every leaf onto the live template's sharding: orbax restores
     # values, but default placement (single-device scalars) would poison the
     # next jit with mixed device sets — params must come back replicated over
-    # the mesh and the ZeRO-1 trace sharded along it.
+    # the mesh and the ZeRO-1 trace sharded along it. Single-process only:
+    # FORCED copy into a jax-OWNED buffer first (same discipline as the
+    # engine's elastic _state_from_host) — on the CPU backend device_put
+    # can zero-copy alias the buffer the orbax restore machinery owns, and
+    # the hot-path executables DONATE these leaves; donation of an aliased
+    # buffer double-frees once the restore tree is collected (observed:
+    # segfault in addressable_shards a few steps into the first post-resume
+    # epoch, heap-layout dependent). Multi-host leaves span non-addressable
+    # devices (a host materialization would raise), so they re-place
+    # directly — orbax owns no host-side alias of a distributed array.
+    import jax.numpy as jnp
+
+    copy_first = jax.process_count() == 1
     state = jax.tree_util.tree_map(
         lambda restored, tmpl: jax.device_put(
-            restored, getattr(tmpl, "sharding", None)
+            jnp.array(restored, copy=True) if copy_first else restored,
+            getattr(tmpl, "sharding", None),
         ),
         state,
         state_template,

@@ -49,6 +49,10 @@ def main() -> None:
         # small window so the streaming host path (prefetch + per-window
         # make_array_from_process_local_data) is exercised ACROSS processes
         stream_chunk_steps=2,
+        # elastic harness mode (ISSUE 6): arm the per-process heartbeat
+        # beacon + peer watcher under DBS_PEER_HB_DIR so the parent can
+        # preempt a REAL worker process and assert the survivor detects it
+        elastic="on" if os.environ.get("DBS_MH_ELASTIC") == "1" else "off",
     )
 
     factors = np.array([3.0, 1.0, 1.0, 1.0])

@@ -1,0 +1,34 @@
+"""Seeded G010 violations: blocking device-side calls in a retry/recovery
+scope with no ``heartbeat()`` coverage and no retry/timeout wrapper.
+
+Recovery scopes run exactly when the fleet is misbehaving — a blocking PJRT
+call there can hang in C++ against a dead runtime, and without a heartbeat
+the stall watchdog reads the recovery itself as the hang.
+"""
+
+import jax
+
+from dynamic_load_balance_distributeddnn_tpu.runtime.health import (
+    retry_transient,
+)
+
+
+class MiniEngine:
+    def __init__(self, steps, state):
+        self.steps = steps
+        self.state = state
+
+    def _recover_world(self, survivors, dev):
+        # G010: device_put + block_until_ready in a recovery scope, no
+        # heartbeat anywhere in the function
+        placed = jax.device_put(self.state, dev)
+        jax.block_until_ready(placed)
+        return survivors
+
+    def _readmit_worker(self, lowered):
+        # G010: a blocking XLA backend compile on the readmission edge
+        return lowered.compile()
+
+    def _reshard_guarded(self, survivors, dev):
+        # quiet: the blocking edge rides retry_transient's tick/backoff
+        return retry_transient(lambda: jax.device_put(self.state, dev))

@@ -38,6 +38,7 @@ def codes(findings):
         ("g007_violation.py", "G007", 2),  # execute-warm loop + timed compile
         ("g008_violation.py", "G008", 2),  # recorded series + meta write
         ("g009_violation.py", "G009", 4),  # steps + jit dispatch, lower, compile
+        ("g010_violation.py", "G010", 3),  # device_put + block + compile
     ],
 )
 def test_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):

@@ -13,9 +13,11 @@ identical partition plan, and the plan shifts away from the slow worker.
 
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -116,3 +118,63 @@ def test_two_process_training():
     assert shares[0] < 0.15
     # shares are rounded to 6 decimals in the worker's JSON
     assert abs(shares.sum() - 1.0) < 1e-5
+
+
+def test_elastic_peer_loss_detection(tmp_path):
+    """ISSUE 6 multi-host story: cross-process recovery is deliberately out
+    of scope (a dead peer takes its mesh slice with it — README "Fault
+    tolerance"), but a lost peer PROCESS must be *detected and diagnosed*,
+    not silently hung on. Preempt one REAL worker process mid-run (SIGSTOP:
+    the freeze case — no socket teardown races the detection the way a kill
+    can); the survivor's peer watcher sees the stale heartbeat file and
+    drops the detection marker from its watcher thread, even while its main
+    thread is wedged in the collective against the frozen peer."""
+    port = _free_port()
+    hb_dir = tmp_path / "hb"
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DBS_MH_ELASTIC"] = "1"
+    env["DBS_PEER_HB_DIR"] = str(hb_dir)
+    env["DBS_PEER_HB_PERIOD_S"] = "0.2"
+    env["DBS_PEER_HB_STALE_S"] = "2.0"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), "2", str(port)],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env=env,
+            cwd=_REPO,
+        )
+        for i in range(2)
+    ]
+    marker = hb_dir / "elastic_detected_proc1_by_proc0.json"
+    try:
+        deadline = time.time() + 300
+        # beacons arm at Trainer construction (post-rendezvous)
+        while time.time() < deadline and not (
+            (hb_dir / "proc0.hb").exists() and (hb_dir / "proc1.hb").exists()
+        ):
+            if any(p.poll() is not None for p in procs):
+                pytest.fail("a worker died before the beacons armed")
+            time.sleep(0.2)
+        assert (hb_dir / "proc1.hb").exists(), "beacons never armed"
+
+        procs[1].send_signal(signal.SIGSTOP)  # the preemption freeze
+        while time.time() < deadline and not marker.exists():
+            time.sleep(0.2)
+        assert marker.exists(), "survivor never detected the lost peer"
+        info = json.loads(marker.read_text())
+        assert info["peer"] == "proc1"
+        assert "stale" in info["reason"] or "exit" in info["reason"]
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGCONT)
+            except (OSError, ProcessLookupError):
+                pass
+            p.kill()
+            p.wait(timeout=30)

@@ -1,13 +1,21 @@
 """graftlint: static + runtime correctness tooling for the TPU/JAX codebase.
 
-Two halves, one contract — keep the DBS loop's timing signal trustworthy and
-its XLA compile count bounded:
+Three parts, one contract — keep the DBS loop's timing signal trustworthy,
+its XLA compile count bounded, and its concurrency/donation discipline
+sound:
 
-* :mod:`.linter` / :mod:`.rules` — an AST linter with repo-specific rules
-  (G001-G008) for the structural perf bugs this repo has actually shipped:
-  jit-in-hot-scope recompile churn, un-synced walls around async dispatches,
-  off-ladder batch shapes, tracer coercion, use-after-donation, per-step
-  transfers, execute-to-compile warms, unattributable recorded walls.
+* :mod:`.linter` / :mod:`.rules` — an AST linter with repo-specific
+  single-file rules (G001-G010) for the structural perf bugs this repo has
+  actually shipped: jit-in-hot-scope recompile churn, un-synced walls
+  around async dispatches, off-ladder batch shapes, tracer coercion,
+  use-after-donation, per-step transfers, execute-to-compile warms,
+  unattributable recorded walls, AOT-registry bypass, unguarded recovery
+  blocking.
+* :mod:`.flow` — the whole-program dataflow engine (``graftlint --flow``):
+  per-module summaries (content-hash cached), a call graph with
+  interprocedural fact propagation, and rules G011 (donation lifetimes),
+  G012 (thread/lock discipline), G013 (stale-mesh placement) — the bug
+  classes single-file analysis structurally cannot see.
 * :mod:`.guards` — runtime guards hooked on ``jax.monitoring`` compile
   events: :func:`~.guards.compile_budget` asserts a compile bound over a code
   region cheaply, and :class:`~.guards.CompileTracker` lets the engine log
@@ -39,3 +47,8 @@ __all__ = [
     "lint_source",
     "RULES",
 ]
+
+# The flow package (G011-G013) is deliberately NOT re-exported here — it
+# pulls in the whole-program engine; import
+# `dynamic_load_balance_distributeddnn_tpu.analysis.flow` directly for the
+# library API (analyze_paths / Project / CallGraph / FLOW_RULES).

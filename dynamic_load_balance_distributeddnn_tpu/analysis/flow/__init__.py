@@ -1,0 +1,62 @@
+"""graftflow: whole-program dataflow analysis for graftlint.
+
+Pipeline: every module is parsed once and lowered to a picklable
+:class:`~.ir.ModuleSummary` (content-hash cached, project.py), a
+:class:`~.callgraph.CallGraph` resolves calls and propagates interprocedural
+facts (donated params/attrs, return aliases, foreign-buffer returns, lock
+environments, thread reachability), and the flow rules (rules.py G011-G013)
+check donation lifetimes, thread/lock discipline, and stale-mesh placement
+over the whole package at once. ``graftlint --flow`` is the CLI entry;
+:func:`analyze_paths` the library one.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.callgraph import CallGraph
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.ir import (
+    FunctionSummary,
+    ModuleSummary,
+    summarize_module,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import (
+    Project,
+    summarize_file,
+    summarize_source,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.rules import (
+    FLOW_RULES,
+    run_flow_rules,
+)
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    select: Optional[Sequence[str]] = None,
+    cache_dir: Optional[str] = None,
+) -> List:
+    """Whole-program flow findings over ``paths`` (files, pre-expanded)."""
+    project = Project.load(paths, cache_dir=cache_dir)
+    return run_flow_rules(project, select=select)
+
+
+def analyze_source(source: str, path: str = "<string>", select=None) -> List:
+    """Single-source convenience used by the fixture tests."""
+    project = Project.from_summaries([summarize_source(source, path)])
+    return run_flow_rules(project, select=select)
+
+
+__all__ = [
+    "CallGraph",
+    "FLOW_RULES",
+    "FunctionSummary",
+    "ModuleSummary",
+    "Project",
+    "analyze_paths",
+    "analyze_source",
+    "run_flow_rules",
+    "summarize_file",
+    "summarize_module",
+    "summarize_source",
+]

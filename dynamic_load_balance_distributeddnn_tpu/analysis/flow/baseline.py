@@ -1,0 +1,101 @@
+"""Suppression/baseline file for graftlint findings.
+
+Whole-program rules land on a tree that predates them, so the CLI supports a
+baseline: ``graftlint --flow --write-baseline .graftlint-baseline.json``
+records the current findings, and later runs with ``--baseline <file>``
+report only NEW findings — the ratchet CI needs to adopt G011-G013 without
+first fixing every historical site.
+
+Entries match on ``(code, path, symbol)`` — symbol is the defining
+``module::qualname`` (or ``module::Class``) a finding anchors to, which is
+stable under unrelated edits; a finding without a symbol falls back to
+``(code, path, message)``. Line numbers are recorded for humans but never
+matched (they drift on every edit above the finding).
+
+Format (JSON)::
+
+    {"version": 1,
+     "suppressions": [
+       {"code": "G012", "path": "dynamic_.../runtime/foo.py",
+        "symbol": "runtime.foo::Service", "reason": "...", "line": 41}]}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+BASELINE_VERSION = 1
+
+
+def _norm_path(path: str) -> str:
+    """One spelling per file across invocations: absolute paths under the
+    current directory relativize (CI runs `graftlint pkg/` from the repo
+    root, editors pass absolute paths — the keys must agree). NEVER a
+    character-set strip: lstrip("./") would eat a leading "/" and collide
+    "../pkg/foo.py" with "pkg/foo.py"."""
+    p = os.path.normpath(path)
+    try:
+        rel = os.path.relpath(p)
+        if not rel.startswith(".."):
+            p = rel
+    except ValueError:  # pragma: no cover - different drive on Windows
+        pass
+    return p.replace(os.sep, "/")
+
+
+def _key(code: str, path: str, symbol: str, message: str) -> Tuple[str, str, str]:
+    if symbol:
+        return (code, _norm_path(path), f"sym:{symbol}")
+    return (code, _norm_path(path), f"msg:{message}")
+
+
+def finding_key(finding) -> Tuple[str, str, str]:
+    return _key(finding.code, finding.path, finding.symbol, finding.message)
+
+
+def write_baseline(path: str, findings: Sequence) -> None:
+    entries: List[Dict] = []
+    seen: Set[Tuple[str, str, str]] = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.code)):
+        key = finding_key(f)
+        if key in seen:
+            continue
+        seen.add(key)
+        entry = {
+            "code": f.code,
+            "path": _norm_path(f.path),
+            "symbol": f.symbol,
+            "line": f.line,  # informational only — never matched
+            "reason": "baselined pre-existing finding",
+            "message": f.message,
+        }
+        entries.append(entry)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(
+            {"version": BASELINE_VERSION, "suppressions": entries}, fh, indent=2
+        )
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> Set[Tuple[str, str, str]]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "suppressions" not in data:
+        raise ValueError(f"{path}: not a graftlint baseline file")
+    keys: Set[Tuple[str, str, str]] = set()
+    for entry in data["suppressions"]:
+        keys.add(
+            _key(
+                entry.get("code", ""),
+                entry.get("path", ""),
+                entry.get("symbol", ""),
+                entry.get("message", ""),
+            )
+        )
+    return keys
+
+
+def filter_baselined(findings: Iterable, baseline: Set[Tuple[str, str, str]]):
+    return [f for f in findings if finding_key(f) not in baseline]

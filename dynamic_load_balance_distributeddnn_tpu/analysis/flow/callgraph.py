@@ -1,0 +1,472 @@
+"""Call graph + interprocedural fact propagation over graftflow summaries.
+
+Resolution is deliberately modest — this is a repo-specific linter, not a
+type checker: ``self.m(...)`` resolves to a method ``m`` of the caller's own
+class, a bare ``g(...)`` to the same-module function or the project-unique
+function of that name (the from-import idiom), and any other dotted call to
+the project-unique function of its tail. Ambiguity resolves to *nothing*:
+an unresolved edge just means the facts stop propagating there, which errs
+quiet — the zero-noise contract every graftlint rule keeps.
+
+Propagated facts (each a fixpoint over the call graph):
+
+* **donated params** — param ``i`` of ``f`` flows into a donated position of
+  a donating dispatch (KNOWN_DONOR_ATTRS / jit ``donate_argnums``) inside
+  ``f`` or any callee it hands the param to. G011's transfer function.
+* **donated self-attrs** — ``self.X`` donated inside a method (so a caller
+  of that method sees ``self.X`` die at the call site).
+* **return aliases** — the return value may alias param ``i`` / ``self.X``
+  (identity chains, containers, ``device_put`` zero-copy).
+* **foreign returns** — the return is a ``device_put`` of a buffer some
+  external machinery owns (checkpoint restore, file load) without a forced
+  copy: donating such a value is the pre-PR-6 use-after-free.
+* **lock env** — the intersection of self-locks held at every resolved call
+  site (``_ensure_pool_locked``-style callees inherit the caller's lock);
+  spawn edges propagate nothing (the spawning thread's lock is not held on
+  the spawned thread).
+* **thread sides** — functions reachable from thread/executor spawn targets
+  vs from main-thread entry points. G012's raw material.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.ir import (
+    FOREIGN_SOURCE_TAILS,
+    CallFact,
+    FunctionSummary,
+    StmtFact,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import Project
+
+Origin = Tuple[str, ...]  # ("param", name) | ("attr", "self.X") | ("call", tail, name, line) | ("opaque",)
+
+# Tails that collide with stdlib/numpy/jax surface — ``fn.lower(...)``,
+# ``arr.take(...)``, ``d.update(...)`` must NEVER unique-resolve to an
+# unrelated project function of the same name.
+_COMMON_METHOD_TAILS = frozenset(
+    {
+        "add", "append", "clear", "close", "compile", "copy", "count",
+        "extend", "format", "get", "items", "join", "keys", "lower", "mean",
+        "open", "pop", "put", "read", "result", "save", "set", "sort",
+        "split", "start", "submit", "sum", "take", "update", "upper",
+        "values", "wait", "write",
+    }
+)
+
+
+def _is_nested(fn: FunctionSummary) -> bool:
+    """Nested def (closure): qualname deeper than ``func`` / ``Class.method``.
+    Closures are only callable from their defining scope — a dotted call in
+    another module can never legitimately reach one."""
+    depth = fn.qualname.count(".")
+    return depth > (1 if fn.cls else 0)
+
+
+@dataclass(frozen=True)
+class Edge:
+    call: CallFact
+    caller: str  # fqn
+    callee: str  # fqn
+    param_offset: int  # 1 for self-method calls (callee params include self)
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.project = project
+        self._mod_by_key = {m.module: m for m in project.modules.values()}
+        # fqn -> outgoing resolved edges / spawn targets
+        self.edges: Dict[str, List[Edge]] = {}
+        self.spawns: Dict[str, List[str]] = {}
+        self.callers: Dict[str, List[Edge]] = {}
+        self._origin_cache: Dict[str, List[Dict[str, FrozenSet[Origin]]]] = {}
+        self._build()
+        self._propagate()
+
+    # ------------------------------------------------------------ resolution
+
+    def resolve_call(
+        self, call: CallFact, caller: FunctionSummary
+    ) -> Optional[Tuple[FunctionSummary, int]]:
+        """(callee summary, positional param offset) or None."""
+        name, tail = call.name, call.tail
+        if not name:
+            return None
+        if name.startswith("self.") and name.count(".") == 1 and caller.cls:
+            cands = self.project.by_method.get((caller.cls, tail), [])
+            same_mod = [c for c in cands if c.module == caller.module]
+            pick = same_mod[0] if same_mod else (cands[0] if len(cands) == 1 else None)
+            return (pick, 1) if pick is not None else None
+        if "." not in name:
+            cands = [c for c in self.project.by_name.get(name, []) if not c.cls]
+            same_mod = [c for c in cands if c.module == caller.module]
+            if same_mod:
+                return (same_mod[0], 0)
+            if len(cands) == 1:
+                return (cands[0], 0)
+            return None
+        # other dotted spelling: unique project-wide tail (methods included —
+        # the receiver is unknown, so offset 1 when the pick is a method),
+        # gated hard against stdlib/jax collisions: never a common method
+        # name, never a closure, and a cross-module pick only when the
+        # caller's module actually mentions the callee's class/name
+        if tail in _COMMON_METHOD_TAILS:
+            return None
+        cands = [c for c in self.project.by_name.get(tail, []) if not _is_nested(c)]
+        if len(cands) == 1:
+            pick = cands[0]
+            if pick.module != caller.module and not (
+                self._mentions(caller.module, pick.cls or pick.name)
+                or self._mentions(caller.module, pick.module.rsplit(".", 1)[-1])
+            ):
+                return None
+            return (pick, 1 if pick.cls else 0)
+        return None
+
+    def _mentions(self, caller_module: str, ident: str) -> bool:
+        mod = self._mod_by_key.get(caller_module)
+        return mod is not None and ident in mod.mentioned
+
+    def _resolve_target(
+        self, token: str, fn: FunctionSummary
+    ) -> Optional[FunctionSummary]:
+        """Resolve a spawn-target token (``self._run`` / bare name)."""
+        if token.startswith("self.") and token.count(".") == 1 and fn.cls:
+            cands = self.project.by_method.get((fn.cls, token.split(".", 1)[1]), [])
+            same_mod = [c for c in cands if c.module == fn.module]
+            if same_mod:
+                return same_mod[0]
+            return cands[0] if len(cands) == 1 else None
+        tail = token.rsplit(".", 1)[-1]
+        cands = self.project.by_name.get(tail, [])
+        same_mod = [c for c in cands if c.module == fn.module]
+        if len(same_mod) == 1:
+            return same_mod[0]
+        # cross-module spawn target: closures never, and the caller must
+        # actually mention the callee's class/name
+        cands = [c for c in cands if not _is_nested(c)]
+        if len(cands) == 1 and self._mentions(
+            fn.module, cands[0].cls or cands[0].name
+        ):
+            return cands[0]
+        return None
+
+    def _build(self) -> None:
+        for fqn, fn in self.project.functions.items():
+            out: List[Edge] = []
+            spawned: List[str] = []
+            for stmt in fn.stmts:
+                for call in stmt.calls:
+                    res = self.resolve_call(call, fn)
+                    if res is not None:
+                        callee, off = res
+                        out.append(
+                            Edge(
+                                call=call,
+                                caller=fqn,
+                                callee=Project.fqn(callee),
+                                param_offset=off,
+                            )
+                        )
+                for spawn in stmt.spawns:
+                    target = self._resolve_target(spawn.target, fn)
+                    if target is not None:
+                        spawned.append(Project.fqn(target))
+            self.edges[fqn] = out
+            self.spawns[fqn] = spawned
+            for e in out:
+                self.callers.setdefault(e.callee, []).append(e)
+
+    # ---------------------------------------------------------- reachability
+
+    def reachable(self, roots: Sequence[str], spawn_too: bool = True) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.project.functions]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            for e in self.edges.get(cur, ()):
+                if e.callee not in seen:
+                    stack.append(e.callee)
+            if spawn_too:
+                for t in self.spawns.get(cur, ()):
+                    if t not in seen:
+                        stack.append(t)
+        return seen
+
+    def thread_sides(self) -> Tuple[Set[str], Set[str]]:
+        """(thread-side fqns, main-side fqns). Thread side: reachable from
+        any spawn target. Main side: reachable from any entry point — a
+        function that is not itself a spawn target and has no resolved
+        caller (public API surface), e.g. ``submit``/``close``."""
+        targets = sorted({t for ts in self.spawns.values() for t in ts})
+        thread_side = self.reachable(targets)
+        entries = [
+            fqn
+            for fqn in self.project.functions
+            if fqn not in targets and not self.callers.get(fqn)
+        ]
+        main_side = self.reachable(entries, spawn_too=False)
+        return thread_side, main_side
+
+    # ------------------------------------------------- local origin tracking
+
+    def origin_snapshots(
+        self, fn: FunctionSummary
+    ) -> List[Dict[str, FrozenSet[Origin]]]:
+        """Per-statement origin maps: ``snapshots[i]`` is the token->origin
+        state as of statement i, BEFORE its own bind applies (a statement's
+        reads/calls execute before its assignment). Facts must be read at
+        the site they hold — the end-of-function map would let an unrelated
+        later rebind erase a donation/foreign-return that already happened."""
+        fqn = Project.fqn(fn)
+        cached = self._origin_cache.get(fqn)
+        if cached is not None:
+            return cached
+        origins: Dict[str, FrozenSet[Origin]] = {
+            p: frozenset({("param", p)}) for p in fn.params
+        }
+        snapshots: List[Dict[str, FrozenSet[Origin]]] = []
+        for stmt in fn.stmts:
+            snapshots.append(dict(origins))
+            bind = stmt.bind
+            if bind is None:
+                continue
+            srcs: Set[Origin] = set()
+            for tok in bind.alias_sources:
+                if tok in origins:
+                    srcs |= origins[tok]
+                elif tok.startswith("self."):
+                    srcs.add(("attr", tok))
+            if bind.rhs_call_tail:
+                srcs.add(
+                    ("call", bind.rhs_call_tail, bind.rhs_call_name, str(bind.line))
+                )
+            if bind.rhs_is_copy:
+                srcs = {("opaque",)}
+            if not srcs:
+                srcs = {("opaque",)}
+            for tgt in bind.targets:
+                origins[tgt] = frozenset(srcs)
+        self._origin_cache[fqn] = snapshots
+        return snapshots
+
+    def origins_at(
+        self, fn: FunctionSummary, stmt: StmtFact
+    ) -> Dict[str, FrozenSet[Origin]]:
+        snaps = self.origin_snapshots(fn)
+        for i, s in enumerate(fn.stmts):
+            if s is stmt:
+                return snaps[i]
+        return snaps[-1] if snaps else {p: frozenset({("param", p)}) for p in fn.params}
+
+    # ------------------------------------------------------------ fixpoints
+
+    def _propagate(self) -> None:
+        donors = self.project.jit_donors()
+        fns = self.project.functions
+
+        # facts, all keyed by fqn
+        self.donated_params: Dict[str, Dict[int, int]] = {f: {} for f in fns}
+        self.donated_attrs: Dict[str, Dict[str, int]] = {f: {} for f in fns}
+        self.returns_param_alias: Dict[str, Set[int]] = {f: set() for f in fns}
+        self.returns_attr_alias: Dict[str, Set[str]] = {f: set() for f in fns}
+        # fqn -> (line, chain-description) when the return is a foreign put
+        self.foreign_returns: Dict[str, Tuple[int, str]] = {}
+
+        for _ in range(6):  # chains through this repo are short
+            changed = False
+            for fqn, fn in fns.items():
+                changed |= self._flow_one(fqn, fn, donors)
+            if not changed:
+                break
+
+        # lock env: intersection over call sites, spawn edges contribute {}
+        self.lock_env: Dict[str, FrozenSet[str]] = {}
+        spawn_targets = {t for ts in self.spawns.values() for t in ts}
+        order = list(fns)
+        # initialize entries to {} and everyone else to "unknown" (None)
+        env: Dict[str, Optional[FrozenSet[str]]] = {}
+        for fqn in order:
+            if fqn in spawn_targets or not self.callers.get(fqn):
+                env[fqn] = frozenset()
+            else:
+                env[fqn] = None
+        for _ in range(6):
+            changed = False
+            for fqn in order:
+                if fqn in spawn_targets:
+                    continue  # spawn edge: caller locks are NOT held
+                incoming: Optional[FrozenSet[str]] = None
+                for e in self.callers.get(fqn, ()):
+                    caller_env = env.get(e.caller)
+                    if caller_env is None:
+                        incoming = None
+                        break
+                    site = frozenset(
+                        t.split(".", 1)[1]
+                        for t in e.call.locks
+                        if t.startswith("self.")
+                    )
+                    here = caller_env | site
+                    incoming = here if incoming is None else (incoming & here)
+                else:
+                    if incoming is not None and incoming != env.get(fqn):
+                        env[fqn] = incoming
+                        changed = True
+            if not changed:
+                break
+        for fqn in order:
+            self.lock_env[fqn] = env.get(fqn) or frozenset()
+
+    def _donation_sites(
+        self, fn: FunctionSummary, donors: Dict[str, Tuple[int, ...]]
+    ):
+        """Yield (stmt, call, donated-token, donation-line) for every donor
+        call in ``fn`` — direct donors plus resolved callees that donate one
+        of their params (the interprocedural step)."""
+        fqn = Project.fqn(fn)
+        local_donors = dict(donors)
+        # locals bound to jit(..., donate_argnums=...) inside this function
+        for stmt in fn.stmts:
+            if stmt.bind is not None and stmt.bind.donate_argnums:
+                for t in stmt.bind.targets:
+                    local_donors[t.rsplit(".", 1)[-1]] = stmt.bind.donate_argnums
+        edge_by_call = {id(e.call): e for e in self.edges.get(fqn, ())}
+        for stmt in fn.stmts:
+            for call in stmt.calls:
+                nums = local_donors.get(call.tail)
+                if nums:
+                    for argnum in nums:
+                        if argnum < len(call.args) and call.args[argnum]:
+                            yield stmt, call, call.args[argnum], call.line
+                    continue
+                e = edge_by_call.get(id(call))
+                if e is None:
+                    continue
+                callee_don = self.donated_params.get(e.callee)
+                if not callee_don:
+                    continue
+                callee = self.project.functions[e.callee]
+                for pidx in callee_don:
+                    pos = pidx - e.param_offset
+                    tok: Optional[str] = None
+                    if 0 <= pos < len(call.args):
+                        tok = call.args[pos]
+                    else:
+                        pname = (
+                            callee.params[pidx]
+                            if pidx < len(callee.params)
+                            else None
+                        )
+                        if pname:
+                            for k, v in call.kwargs:
+                                if k == pname:
+                                    tok = v
+                    if tok:
+                        yield stmt, call, tok, call.line
+
+    def _flow_one(
+        self, fqn: str, fn: FunctionSummary, donors: Dict[str, Tuple[int, ...]]
+    ) -> bool:
+        changed = False
+        snaps = self.origin_snapshots(fn)
+        stmt_index = {id(s): i for i, s in enumerate(fn.stmts)}
+        param_index = {p: i for i, p in enumerate(fn.params)}
+
+        # decorator donations: @partial(jax.jit, donate_argnums=...) defs
+        for i in fn.decorator_donate_argnums:
+            if i not in self.donated_params[fqn]:
+                self.donated_params[fqn][i] = fn.line
+                changed = True
+
+        for _stmt, _call, tok, line in self._donation_sites(fn, donors):
+            origins = snaps[stmt_index[id(_stmt)]]
+            for org in origins.get(tok, frozenset({("attr", tok)} if tok.startswith("self.") else ())):
+                if org[0] == "param":
+                    i = param_index.get(org[1])
+                    if i is not None and i not in self.donated_params[fqn]:
+                        self.donated_params[fqn][i] = line
+                        changed = True
+                elif org[0] == "attr":
+                    attr = org[1]
+                    if attr not in self.donated_attrs[fqn]:
+                        self.donated_attrs[fqn][attr] = line
+                        changed = True
+
+        # return aliases + foreign returns
+        edge_by_line: Dict[Tuple[str, int], Edge] = {}
+        for e in self.edges.get(fqn, ()):
+            edge_by_line[(e.call.tail, e.call.line)] = e
+        for si, stmt in enumerate(fn.stmts):
+            if stmt.ret is None:
+                continue
+            origins = snaps[si]
+            for tok in stmt.ret.alias_tokens:
+                for org in origins.get(
+                    tok,
+                    frozenset({("attr", tok)} if tok.startswith("self.") else ()),
+                ):
+                    if org[0] == "param":
+                        i = param_index.get(org[1])
+                        if i is not None and i not in self.returns_param_alias[fqn]:
+                            self.returns_param_alias[fqn].add(i)
+                            changed = True
+                    elif org[0] == "attr":
+                        if org[1] not in self.returns_attr_alias[fqn]:
+                            self.returns_attr_alias[fqn].add(org[1])
+                            changed = True
+                    elif org[0] == "call":
+                        # y = g(...); return y where g returns a foreign put
+                        e = edge_by_line.get((org[1], int(org[3])))
+                        if (
+                            e is not None
+                            and e.callee in self.foreign_returns
+                            and fqn not in self.foreign_returns
+                        ):
+                            src = self.foreign_returns[e.callee][1]
+                            self.foreign_returns[fqn] = (
+                                stmt.ret.line,
+                                f"{org[1]} -> {src}",
+                            )
+                            changed = True
+            if stmt.ret.device_put_of and not stmt.ret.device_put_copied:
+                reason = self._foreign_reason(fn, stmt.ret.device_put_of, origins)
+                if reason and fqn not in self.foreign_returns:
+                    self.foreign_returns[fqn] = (stmt.ret.line, reason)
+                    changed = True
+        return changed
+
+    def _foreign_reason(
+        self,
+        fn: FunctionSummary,
+        tokens: Sequence[str],
+        origins: Dict[str, FrozenSet[Origin]],
+    ) -> Optional[str]:
+        """Why a device_put of ``tokens`` aliases an externally-owned host
+        buffer: the put argument derives from a restore/load-style call (or
+        a param handed in by the caller) with no forced copy in between.
+        Returns a short human-readable chain or None (not foreign)."""
+        for tok in tokens:
+            for org in origins.get(tok, frozenset()):
+                if org[0] == "call":
+                    tail = org[1]
+                    if tail in FOREIGN_SOURCE_TAILS or any(
+                        tail.startswith(t) or tail.endswith(t)
+                        for t in ("restore", "load")
+                    ):
+                        return f"device_put of `{tok}` from `{org[2] or tail}(...)`"
+        return None
+
+
+def caller_path(project: Project, fn: FunctionSummary) -> str:
+    """Path of the module that defines ``fn`` (summaries store module keys,
+    findings need file paths)."""
+    for path, mod in project.modules.items():
+        if mod.module == fn.module and fn.qualname in mod.functions:
+            return path
+    return fn.module

@@ -1,0 +1,775 @@
+"""Per-function IR for graftflow: the picklable facts whole-program rules run on.
+
+The single-file rules (rules.py G001-G010) walk raw ASTs. Whole-program
+analysis cannot afford that: parsing and walking every module on every run —
+and shipping ASTs across process boundaries for the parallel linter — is the
+cost the content-hash summary cache (project.py) exists to avoid. So each
+function is lowered ONCE into a flat, ordered list of :class:`StmtFact`
+records carrying exactly the facts the flow rules consume:
+
+* **reads/binds/aliases** — dotted-token reads (shallow per statement, the
+  G005 statement discipline), bind targets, and which tokens an RHS trivially
+  aliases (bare name copy, container packing, IfExp arms, ``device_put``).
+* **calls** — resolved-enough callee spellings (dotted name + tail), the
+  dotted token of each argument, and any ``donate_argnums`` on a jit
+  construction.
+* **locks** — the set of self-lock tokens lexically held (``with self._lock:``)
+  at every statement, attribute access, and call site, plus the lock
+  acquisition-order edges the statement introduces.
+* **attribute accesses** — every ``self.<attr>`` read/write with its lock set
+  (thread-discipline raw material).
+* **spawns** — thread/executor targets started by the statement.
+* **returns** — which params/attrs/locals the return value aliases, and
+  whether it is a ``device_put`` of a possibly-foreign (host-owned) buffer.
+
+Everything here is plain tuples/frozensets/dataclasses of str+int: a
+ModuleSummary pickles, so project.py can cache it keyed by content hash and
+the parallel linter can build it in a worker process.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.astutil import (
+    assign_targets,
+    call_name,
+    decorator_names,
+    dotted_name,
+    identifiers_in,
+    is_jit_construction,
+    jit_kwarg,
+    literal_int_tuple,
+)
+
+# Lock-ish constructors: an attribute assigned from one of these is a lock
+# token for the thread-discipline rule (Condition and Event both carry an
+# internal lock; Event is NOT mutual exclusion, so it is deliberately absent).
+_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "Lock",
+    "RLock",
+    "Condition",
+}
+
+# Copy spellings that break a host/device alias: jnp.array/np.array with
+# copy=True, copy.deepcopy, ndarray.copy().
+_COPY_TAILS = {"deepcopy", "copy"}
+_ARRAY_CTORS = {"np.array", "numpy.array", "jnp.array", "jax.numpy.array"}
+
+# Call tails whose RESULT owns host memory some external machinery may also
+# hold (checkpoint restores, file loads): device_put of such a value without
+# a forced copy is the pre-PR-6 donated-restore use-after-free raw material.
+FOREIGN_SOURCE_TAILS = {
+    "restore",
+    "restore_checkpoint",
+    "load",
+    "frombuffer",
+    "memmap",
+}
+
+_PUT_TAILS = {"device_put", "device_put_sharded", "device_put_replicated"}
+
+# Thread-spawn spellings: Thread(target=f), pool.submit(f, ...),
+# executor.map(f, ...). The spawned callee runs on another thread, so lock
+# context must NOT propagate across these edges (callgraph.py).
+_SPAWN_CTOR_TAILS = {"Thread"}
+_SPAWN_SUBMIT_TAILS = {"submit"}
+
+
+@dataclass(frozen=True)
+class CallFact:
+    """One call site, shallow within its statement."""
+
+    name: str  # full dotted spelling ("self.steps.fused_step") or ""
+    tail: str  # last component ("fused_step")
+    line: int
+    col: int
+    args: Tuple[Optional[str], ...]  # dotted token per positional arg (or None)
+    kwargs: Tuple[Tuple[str, Optional[str]], ...]
+    arg_idents: Tuple[FrozenSet[str], ...]  # all identifiers per positional arg
+    kwarg_idents: Tuple[Tuple[str, FrozenSet[str]], ...]
+    locks: FrozenSet[str]  # self-lock tokens lexically held at the site
+    donate_argnums: Tuple[int, ...] = ()  # non-empty on jit constructions
+    in_loop: bool = False
+
+
+@dataclass(frozen=True)
+class BindFact:
+    """The binding effect of one statement (Assign/AugAssign/For/With...)."""
+
+    targets: Tuple[str, ...]  # plain AND dotted targets ("x", "self.state")
+    line: int
+    rhs_idents: FrozenSet[str]
+    rhs_call_tail: str  # tail of the RHS call, "" when RHS is not a call
+    rhs_call_name: str
+    alias_sources: Tuple[str, ...]  # tokens the RHS value may alias
+    rhs_is_copy: bool  # RHS is a forced-copy spelling (breaks aliases)
+    donate_argnums: Tuple[int, ...] = ()  # RHS is jit(..., donate_argnums=...)
+
+
+@dataclass(frozen=True)
+class AttrAccess:
+    """One ``self.<attr>`` touch (methods only; ``self`` receiver)."""
+
+    attr: str
+    write: bool
+    line: int
+    col: int
+    locks: FrozenSet[str]
+    rhs_idents: FrozenSet[str] = frozenset()  # write only: identifiers in RHS
+
+
+@dataclass(frozen=True)
+class SpawnFact:
+    """A thread/executor start whose target runs concurrently."""
+
+    target: str  # dotted token of the target callable
+    line: int
+
+
+@dataclass(frozen=True)
+class RetFact:
+    alias_tokens: Tuple[str, ...]  # tokens the returned value may alias
+    device_put_of: Tuple[str, ...]  # put args when return IS a device_put(...)
+    device_put_copied: bool  # every put arg is copy-wrapped
+    line: int
+
+
+@dataclass(frozen=True)
+class StmtFact:
+    """One statement, flattened in source order (compound headers included;
+    their nested statements appear on their own — the G005 shallow walk)."""
+
+    line: int
+    col: int
+    # (enclosing-If id, arm) pairs: two stmts sharing an id with different
+    # arms are mutually exclusive (the donate-in-one-branch sanction)
+    guards: Tuple[Tuple[int, str], ...]
+    reads: Tuple[Tuple[str, int, int], ...]  # (dotted token, line, col), Load ctx
+    bind: Optional[BindFact]
+    calls: Tuple[CallFact, ...]
+    ret: Optional[RetFact]
+    attr_accesses: Tuple[AttrAccess, ...]
+    spawns: Tuple[SpawnFact, ...]
+    locks: FrozenSet[str]
+
+
+@dataclass(frozen=True)
+class FunctionSummary:
+    """Everything the flow rules need about one function, AST-free."""
+
+    qualname: str  # "Class.method" or "func" (module-local)
+    module: str  # module key (relative path)
+    name: str
+    cls: str  # enclosing class name or ""
+    line: int
+    params: Tuple[str, ...]
+    stmts: Tuple[StmtFact, ...]
+    decorator_donate_argnums: Tuple[int, ...] = ()  # @partial(jit, donate_...)
+    lock_order_edges: Tuple[Tuple[str, str], ...] = ()  # (outer, inner) tokens
+    is_setup: bool = False  # __init__/setup/build-style scope
+
+
+@dataclass
+class ModuleSummary:
+    """Picklable per-module facts — the unit the content-hash cache stores."""
+
+    path: str
+    module: str  # dotted-ish module key derived from the path
+    functions: Dict[str, FunctionSummary] = field(default_factory=dict)
+    classes: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    lock_attrs: Dict[str, FrozenSet[str]] = field(default_factory=dict)
+    # module-level donors: name/attr-tail -> donated positions, from
+    # jit(..., donate_argnums=...) bindings anywhere in the file
+    jit_donors: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    # line -> set of inline-suppressed rule codes (graftlint: disable=...)
+    suppressions: Dict[int, FrozenSet[str]] = field(default_factory=dict)
+    # every identifier the module mentions (Name ids + import names) — the
+    # callgraph's cross-module resolution gate: ``obj.m(...)`` may resolve
+    # to class C's method only if this module actually names C somewhere
+    mentioned: FrozenSet[str] = frozenset()
+
+
+_SETUP_NAMES = {"__init__", "__post_init__", "setup", "__init_subclass__"}
+_SETUP_PREFIXES = (
+    "build", "_build", "make_", "_make", "create_", "_create",
+    # construction-phase helpers (`_setup_data`/`_setup_model`): they run
+    # from __init__, before any package thread exists, so their attribute
+    # writes are not cross-thread mutations
+    "setup_", "_setup",
+)
+
+
+def _is_setup_name(name: str) -> bool:
+    return name in _SETUP_NAMES or name.startswith(_SETUP_PREFIXES)
+
+
+def _attr_tail(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _is_copy_expr(node: ast.expr) -> bool:
+    """``jnp.array(x, copy=True)`` / ``copy.deepcopy(x)`` / ``x.copy()`` /
+    an IfExp with EVERY arm copy-wrapped."""
+    if isinstance(node, ast.IfExp):
+        return _is_copy_expr(node.body) and _is_copy_expr(node.orelse)
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    tail = _attr_tail(name)
+    if name in _ARRAY_CTORS:
+        for kw in node.keywords:
+            if kw.arg == "copy":
+                try:
+                    return bool(ast.literal_eval(kw.value))
+                except (ValueError, SyntaxError):
+                    return False
+        return False
+    return tail in _COPY_TAILS and not node.args and not node.keywords or (
+        tail == "deepcopy"
+    )
+
+
+def _alias_sources(node: ast.expr) -> List[str]:
+    """Tokens the value of ``node`` may alias, shallowly: a bare name/dotted
+    read, every element of a container literal, both arms of an IfExp, the
+    argument of a device_put (zero-copy on CPU), a starred unpack."""
+    out: List[str] = []
+
+    def walk(n: ast.expr) -> None:
+        tok = dotted_name(n)
+        if tok is not None:
+            out.append(tok)
+            return
+        if isinstance(n, (ast.Tuple, ast.List, ast.Set)):
+            for e in n.elts:
+                walk(e)
+        elif isinstance(n, ast.Starred):
+            walk(n.value)
+        elif isinstance(n, ast.IfExp):
+            walk(n.body)
+            walk(n.orelse)
+        elif isinstance(n, ast.Call) and _attr_tail(call_name(n)) in _PUT_TAILS:
+            if n.args and not _is_copy_expr(n.args[0]):
+                walk(n.args[0])
+        elif isinstance(n, ast.Subscript):
+            # t[0] aliases (an element of) t — coarse, matches the
+            # "reachable through containers" contract
+            walk(n.value)
+        elif isinstance(n, ast.Await):
+            walk(n.value)
+
+    walk(node)
+    return out
+
+
+def _dotted_targets(stmt: ast.stmt) -> List[str]:
+    """Plain + dotted assignment targets (``x``, ``self.state``); subscripted
+    targets contribute their container token (``extras["k"] = v`` -> extras)."""
+    out: List[str] = []
+
+    def collect(t: ast.expr) -> None:
+        base = t
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        tok = dotted_name(base)
+        if tok is not None:
+            out.append(tok)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect(e)
+        elif isinstance(t, ast.Starred):
+            collect(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            collect(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        collect(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        collect(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                collect(item.optional_vars)
+    return out
+
+
+class _FunctionLowerer:
+    """Lowers one FunctionDef into a FunctionSummary."""
+
+    def __init__(
+        self,
+        fn: ast.AST,
+        qualname: str,
+        module: str,
+        cls: str,
+        parents: Dict[ast.AST, ast.AST],
+    ):
+        self.fn = fn
+        self.qualname = qualname
+        self.module = module
+        self.cls = cls
+        self.parents = parents
+        self._if_ids: Dict[int, int] = {}  # id(If node) -> stable small int
+        self.lock_edges: Set[Tuple[str, str]] = set()
+
+    # -- scope helpers ------------------------------------------------------
+
+    def _innermost_fn(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def _own(self, node: ast.AST) -> bool:
+        return self._innermost_fn(node) is self.fn
+
+    def _stmt_list(self) -> List[ast.stmt]:
+        stmts = [
+            n
+            for n in ast.walk(self.fn)
+            if isinstance(n, ast.stmt) and n is not self.fn and self._own(n)
+        ]
+        return sorted(stmts, key=lambda s: (s.lineno, s.col_offset))
+
+    @staticmethod
+    def _shallow_walk(stmt: ast.stmt):
+        """stmt + non-statement descendants (nested stmts get their own
+        StmtFact). Nested function/lambda bodies are separate scopes and are
+        NOT entered."""
+        stack: List[ast.AST] = [stmt]
+        first = True
+        while stack:
+            node = stack.pop()
+            if not first and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            first = False
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, ast.stmt):
+                    stack.append(child)
+
+    # -- lock context -------------------------------------------------------
+
+    def _locks_at(self, node: ast.AST) -> FrozenSet[str]:
+        """self-lock tokens held lexically at ``node``: enclosing
+        ``with self.<lock>:`` items up to the function boundary. Tokens are
+        raw dotted spellings ("self._lock"); project.py filters them against
+        the class's known lock attributes."""
+        held: Set[str] = set()
+        cur = self.parents.get(node)
+        while cur is not None and cur is not self.fn:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    tok = dotted_name(item.context_expr)
+                    if tok is not None:
+                        held.add(tok)
+                    elif isinstance(item.context_expr, ast.Call):
+                        # lock.acquire()-style CMs don't exist; but
+                        # ``with self._cv:`` is the Name path above. A
+                        # ``with self._lock_for(x):`` call is opaque — skip.
+                        pass
+            cur = self.parents.get(cur)
+        return frozenset(held)
+
+    # -- guards (mutually-exclusive branches) -------------------------------
+
+    def _guards(self, stmt: ast.stmt) -> Tuple[Tuple[int, str], ...]:
+        out: List[Tuple[int, str]] = []
+        child: ast.AST = stmt
+        cur = self.parents.get(stmt)
+        while cur is not None and cur is not self.fn:
+            if isinstance(cur, ast.If):
+                if any(child is s for s in cur.body):
+                    arm = "body"
+                elif any(child is s for s in cur.orelse):
+                    arm = "orelse"
+                else:
+                    arm = ""
+                if arm:
+                    if_id = self._if_ids.setdefault(id(cur), len(self._if_ids))
+                    out.append((if_id, arm))
+            child = cur
+            cur = self.parents.get(cur)
+        return tuple(out)
+
+    # -- per-statement facts ------------------------------------------------
+
+    def _call_fact(self, node: ast.Call, in_loop: bool) -> CallFact:
+        name = call_name(node) or ""
+        args = tuple(dotted_name(a) for a in node.args)
+        kwargs = tuple((kw.arg or "**", dotted_name(kw.value)) for kw in node.keywords)
+        arg_idents = tuple(frozenset(identifiers_in(a)) for a in node.args)
+        kwarg_idents = tuple(
+            (kw.arg or "**", frozenset(identifiers_in(kw.value)))
+            for kw in node.keywords
+        )
+        donate: Tuple[int, ...] = ()
+        if is_jit_construction(node):
+            donate = literal_int_tuple(jit_kwarg(node, "donate_argnums")) or ()
+        return CallFact(
+            name=name,
+            tail=_attr_tail(name),
+            line=node.lineno,
+            col=node.col_offset,
+            args=args,
+            kwargs=kwargs,
+            arg_idents=arg_idents,
+            kwarg_idents=kwarg_idents,
+            locks=self._locks_at(node),
+            donate_argnums=donate,
+            in_loop=in_loop,
+        )
+
+    def _spawns_in(self, calls: Sequence[ast.Call]) -> List[SpawnFact]:
+        out: List[SpawnFact] = []
+        for node in calls:
+            tail = _attr_tail(call_name(node))
+            target: Optional[str] = None
+            if tail in _SPAWN_CTOR_TAILS:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = dotted_name(kw.value)
+            elif tail in _SPAWN_SUBMIT_TAILS and node.args:
+                target = dotted_name(node.args[0])
+            if target:
+                out.append(SpawnFact(target=target, line=node.lineno))
+        return out
+
+    def _bind_fact(self, stmt: ast.stmt) -> Optional[BindFact]:
+        targets = _dotted_targets(stmt)
+        if not targets:
+            return None
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+        if value is None:
+            # For/With targets: fresh bindings with opaque sources
+            return BindFact(
+                targets=tuple(targets),
+                line=stmt.lineno,
+                rhs_idents=frozenset(),
+                rhs_call_tail="",
+                rhs_call_name="",
+                alias_sources=(),
+                rhs_is_copy=False,
+            )
+        rhs_call_name = ""
+        donate: Tuple[int, ...] = ()
+        if isinstance(value, ast.Call):
+            rhs_call_name = call_name(value) or ""
+            if is_jit_construction(value):
+                donate = literal_int_tuple(jit_kwarg(value, "donate_argnums")) or ()
+        return BindFact(
+            targets=tuple(targets),
+            line=stmt.lineno,
+            rhs_idents=frozenset(identifiers_in(value)),
+            rhs_call_tail=_attr_tail(rhs_call_name),
+            rhs_call_name=rhs_call_name,
+            alias_sources=tuple(_alias_sources(value)),
+            rhs_is_copy=_is_copy_expr(value),
+            donate_argnums=donate,
+        )
+
+    def _ret_fact(self, stmt: ast.Return) -> RetFact:
+        if stmt.value is None:
+            return RetFact((), (), False, stmt.lineno)
+        put_of: Tuple[str, ...] = ()
+        put_copied = False
+        v = stmt.value
+        if isinstance(v, ast.Call) and _attr_tail(call_name(v)) in _PUT_TAILS:
+            if v.args:
+                srcs = _alias_sources(v.args[0]) or [
+                    t for t in [dotted_name(v.args[0])] if t
+                ]
+                put_of = tuple(srcs) or ("<expr>",)
+                put_copied = _is_copy_expr(v.args[0])
+        return RetFact(
+            alias_tokens=tuple(_alias_sources(v)),
+            device_put_of=put_of,
+            device_put_copied=put_copied,
+            line=stmt.lineno,
+        )
+
+    def _attr_accesses(
+        self, stmt: ast.stmt, locks: FrozenSet[str]
+    ) -> List[AttrAccess]:
+        out: List[AttrAccess] = []
+        write_rhs: FrozenSet[str] = frozenset()
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                write_rhs = frozenset(identifiers_in(stmt.value))
+        for n in self._shallow_walk(stmt):
+            if (
+                isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"
+            ):
+                node_locks = self._locks_at(n) or locks
+                if isinstance(n.ctx, (ast.Store, ast.Del)):
+                    out.append(
+                        AttrAccess(
+                            attr=n.attr,
+                            write=True,
+                            line=n.lineno,
+                            col=n.col_offset,
+                            locks=node_locks,
+                            rhs_idents=write_rhs,
+                        )
+                    )
+                elif isinstance(n.ctx, ast.Load):
+                    # self.x[...] = v / self.x.append(v): a Load of the
+                    # handle that MUTATES through it — classify as write
+                    parent = self.parents.get(n)
+                    is_mut = False
+                    if isinstance(parent, ast.Subscript) and isinstance(
+                        parent.ctx, (ast.Store, ast.Del)
+                    ):
+                        is_mut = True
+                    elif (
+                        isinstance(parent, ast.Attribute)
+                        and isinstance(self.parents.get(parent), ast.Call)
+                        and parent.attr
+                        in (
+                            "append",
+                            "add",
+                            "pop",
+                            "popleft",
+                            "clear",
+                            "update",
+                            "extend",
+                            "remove",
+                            "appendleft",
+                            "setdefault",
+                            "discard",
+                        )
+                        and self.parents.get(parent).func is parent
+                    ):
+                        is_mut = True
+                    out.append(
+                        AttrAccess(
+                            attr=n.attr,
+                            write=is_mut,
+                            line=n.lineno,
+                            col=n.col_offset,
+                            locks=node_locks,
+                            rhs_idents=write_rhs if is_mut else frozenset(),
+                        )
+                    )
+        return out
+
+    def _reads(self, stmt: ast.stmt) -> List[Tuple[str, int, int]]:
+        out: List[Tuple[str, int, int]] = []
+        for n in self._shallow_walk(stmt):
+            if isinstance(n, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(n, "ctx", None), ast.Load
+            ):
+                tok = dotted_name(n)
+                if tok is not None:
+                    # only record the OUTERMOST dotted spelling; dotted_name
+                    # on the inner Name would double-count
+                    parent = self.parents.get(n)
+                    if isinstance(parent, ast.Attribute) and dotted_name(parent):
+                        continue
+                    out.append((tok, n.lineno, n.col_offset))
+        return out
+
+    def _lock_order(self, stmt: ast.stmt) -> None:
+        """with self.A: ... with self.B: -> edge (A-token, B-token)."""
+        if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return
+        inner_locks = {
+            tok
+            for item in stmt.items
+            for tok in [dotted_name(item.context_expr)]
+            if tok is not None
+        }
+        if not inner_locks:
+            return
+        outer = self._locks_at(stmt)
+        for o in outer:
+            for i in inner_locks:
+                if o != i:
+                    self.lock_edges.add((o, i))
+
+    # -- main ---------------------------------------------------------------
+
+    def lower(self) -> FunctionSummary:
+        fn = self.fn
+        args = fn.args
+        params = tuple(
+            a.arg for a in args.posonlyargs + args.args + args.kwonlyargs
+        )
+        dec_donate: Tuple[int, ...] = ()
+        for dec in getattr(fn, "decorator_list", []):
+            if isinstance(dec, ast.Call) and is_jit_construction(dec):
+                dec_donate = (
+                    literal_int_tuple(jit_kwarg(dec, "donate_argnums")) or ()
+                )
+        stmt_facts: List[StmtFact] = []
+        for stmt in self._stmt_list():
+            self._lock_order(stmt)
+            locks = self._locks_at(stmt)
+            calls = [
+                n
+                for n in self._shallow_walk(stmt)
+                if isinstance(n, ast.Call)
+            ]
+            in_loop = any(
+                isinstance(p, (ast.For, ast.AsyncFor, ast.While))
+                for p in self._ancestors(stmt)
+            )
+            call_facts = tuple(self._call_fact(c, in_loop) for c in calls)
+            ret = self._ret_fact(stmt) if isinstance(stmt, ast.Return) else None
+            stmt_facts.append(
+                StmtFact(
+                    line=stmt.lineno,
+                    col=stmt.col_offset,
+                    guards=self._guards(stmt),
+                    reads=tuple(self._reads(stmt)),
+                    bind=self._bind_fact(stmt),
+                    calls=call_facts,
+                    ret=ret,
+                    attr_accesses=tuple(self._attr_accesses(stmt, locks)),
+                    spawns=tuple(self._spawns_in(calls)),
+                    locks=locks,
+                )
+            )
+        return FunctionSummary(
+            qualname=self.qualname,
+            module=self.module,
+            name=fn.name,
+            cls=self.cls,
+            line=fn.lineno,
+            params=params,
+            stmts=tuple(stmt_facts),
+            decorator_donate_argnums=dec_donate,
+            lock_order_edges=tuple(sorted(self.lock_edges)),
+            is_setup=_is_setup_name(fn.name),
+        )
+
+    def _ancestors(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None and cur is not self.fn:
+            yield cur
+            cur = self.parents.get(cur)
+
+
+def summarize_module(
+    tree: ast.Module,
+    path: str,
+    module: str,
+    parents: Optional[Dict[ast.AST, ast.AST]] = None,
+    lines: Optional[Sequence[str]] = None,
+) -> ModuleSummary:
+    """Lower one parsed module into its picklable summary."""
+    from dynamic_load_balance_distributeddnn_tpu.analysis.astutil import (
+        parent_map,
+        suppressed_rules,
+    )
+
+    if parents is None:
+        parents = parent_map(tree)
+    mentioned: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            mentioned.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                mentioned.add((a.asname or a.name).split(".")[0])
+                mentioned.add(a.name.split(".")[-1])
+            if isinstance(node, ast.ImportFrom) and node.module:
+                # `from pkg.obs.trace import get_tracer` mentions "trace":
+                # a factory-returned object's methods may resolve into the
+                # imported module even though its class is never named
+                mentioned.update(node.module.split("."))
+    summary = ModuleSummary(
+        path=path, module=module, mentioned=frozenset(mentioned)
+    )
+
+    # inline suppressions (line -> codes), so flow findings honor the same
+    # `# graftlint: disable=GXXX` contract as the single-file rules
+    if lines is not None:
+        for i, text in enumerate(lines, start=1):
+            codes = suppressed_rules(text)
+            if codes:
+                summary.suppressions[i] = frozenset(codes)
+
+    # classes and their lock attributes
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            methods = tuple(
+                n.name
+                for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            )
+            summary.classes[node.name] = methods
+            locks: Set[str] = set()
+            for n in ast.walk(node):
+                if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                    if call_name(n.value) in _LOCK_CTORS:
+                        for t in n.targets:
+                            tok = dotted_name(t)
+                            if tok and tok.startswith("self."):
+                                locks.add(tok.split(".", 1)[1])
+            summary.lock_attrs[node.name] = frozenset(locks)
+
+    # module-level jit donors (G005/G011 donor table source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if is_jit_construction(node.value):
+                nums = literal_int_tuple(jit_kwarg(node.value, "donate_argnums"))
+                if nums:
+                    for t in node.targets:
+                        tok = dotted_name(t)
+                        if tok:
+                            summary.jit_donors[tok.rsplit(".", 1)[-1]] = nums
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and is_jit_construction(dec):
+                    nums = literal_int_tuple(jit_kwarg(dec, "donate_argnums"))
+                    if nums:
+                        summary.jit_donors[node.name] = nums
+
+    # functions: module-level, methods, AND nested defs — the watchdog/
+    # heartbeat threads run closures (`_watch`/`_beat`) defined inside
+    # methods, and the thread inventory must see their attribute accesses.
+    # Defs are discovered at ANY statement depth (under if/try/with too),
+    # stopping at function boundaries so each def recurses exactly once.
+    def child_defs(body: Sequence[ast.stmt]):
+        stack = list(body)
+        while stack:
+            node = stack.pop(0)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                yield node
+                continue  # its own visit() call recurses into it
+            for field_ in ("body", "orelse", "finalbody", "handlers"):
+                for sub in getattr(node, field_, []):
+                    if isinstance(sub, ast.ExceptHandler):
+                        stack.extend(sub.body)
+                    elif isinstance(sub, ast.stmt):
+                        stack.append(sub)
+
+    def visit(body: Sequence[ast.stmt], cls: str, prefix: str) -> None:
+        for node in child_defs(body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{node.name}" if prefix else node.name
+                summary.functions[qual] = _FunctionLowerer(
+                    node, qual, module, cls, parents
+                ).lower()
+                visit(node.body, cls, qual)
+            else:  # ClassDef
+                visit(node.body, node.name, node.name)
+
+    visit(tree.body, "", "")
+    return summary

@@ -1,0 +1,190 @@
+"""graftflow project loader: parse every module once, summary-cache by hash.
+
+A :class:`Project` is the whole-program unit the flow rules see: one
+:class:`~.ir.ModuleSummary` per file plus indexes (functions by qualified
+name, bare name, and (class, method)). Summaries are pure data, so they are
+cached on disk keyed by ``sha256(file bytes)`` + the IR schema version — a
+repo-wide ``graftlint --flow`` run after one small edit re-lowers exactly the
+edited files and loads everything else from cache (the self-runtime budget
+test in tests/test_graftflow.py holds the full cold run to a bound anyway;
+the cache is what keeps the warm CI/pre-commit path near-instant).
+
+Cache layout: ``<cache_dir>/<sha256>-<schema>.sum`` pickles, best-effort —
+any read/unpickle failure silently falls back to re-lowering the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import pickle
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.ir import (
+    FunctionSummary,
+    ModuleSummary,
+    summarize_module,
+)
+
+# Bump whenever the IR schema or lowering semantics change: stale cache
+# entries must miss, not deserialize into wrong-shaped facts.
+IR_SCHEMA_VERSION = "gf1"
+
+
+def default_cache_dir() -> str:
+    """Per-user cache dir: the cache stores pickles, and unpickling a file
+    another user planted at a predictable name in a shared /tmp would be
+    arbitrary code execution — so the default is uid-suffixed and created
+    0700 (see :func:`_ensure_private_dir`)."""
+    env = os.environ.get("GRAFTLINT_CACHE_DIR")
+    if env:
+        return env
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    return os.path.join(tempfile.gettempdir(), f"graftlint-cache-{uid}")
+
+
+def _ensure_private_dir(path: str) -> None:
+    os.makedirs(path, mode=0o700, exist_ok=True)
+    try:
+        if os.stat(path).st_uid != os.getuid():
+            raise OSError(f"cache dir {path} is owned by another user")
+        os.chmod(path, 0o700)  # makedirs mode is umask-filtered
+    except AttributeError:  # pragma: no cover - non-POSIX
+        pass
+
+
+def content_hash(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _cache_path(cache_dir: str, digest: str) -> str:
+    return os.path.join(cache_dir, f"{digest}-{IR_SCHEMA_VERSION}.sum")
+
+
+def load_cached_summary(cache_dir: str, digest: str) -> Optional[ModuleSummary]:
+    try:
+        with open(_cache_path(cache_dir, digest), "rb") as fh:
+            obj = pickle.load(fh)
+        return obj if isinstance(obj, ModuleSummary) else None
+    except (OSError, pickle.UnpicklingError, EOFError, AttributeError):
+        return None
+
+
+def store_cached_summary(
+    cache_dir: str, digest: str, summary: ModuleSummary
+) -> None:
+    try:
+        _ensure_private_dir(cache_dir)
+        tmp = _cache_path(cache_dir, digest) + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump(summary, fh)
+        os.replace(tmp, _cache_path(cache_dir, digest))
+    except OSError:
+        pass  # cache is best-effort; the lint result must not depend on it
+
+
+def module_key(path: str) -> str:
+    """Stable module key derived from the path: the dotted tail under the
+    package root when recognizable, else the basename stem."""
+    norm = os.path.normpath(path).replace(os.sep, "/")
+    parts = norm.rsplit(".py", 1)[0].split("/")
+    pkg = "dynamic_load_balance_distributeddnn_tpu"
+    if pkg in parts:
+        parts = parts[parts.index(pkg):]
+    else:
+        parts = parts[-1:]
+    return ".".join(p for p in parts if p)
+
+
+def summarize_source(
+    source: str, path: str, tree: Optional[ast.Module] = None
+) -> ModuleSummary:
+    if tree is None:
+        tree = ast.parse(source, filename=path)
+    return summarize_module(
+        tree, path=path, module=module_key(path), lines=source.splitlines()
+    )
+
+
+def summarize_file(
+    path: str, cache_dir: Optional[str] = None, data: Optional[bytes] = None
+) -> ModuleSummary:
+    """Summary for one file, through the content-hash cache when given.
+    ``data`` lets a caller that already read the bytes (the parallel
+    linter) share ONE implementation of the load-validate-store protocol."""
+    if data is None:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    if cache_dir is not None:
+        digest = content_hash(data)
+        cached = load_cached_summary(cache_dir, digest)
+        if cached is not None and cached.module == module_key(path):
+            # path can differ between runs (relative vs absolute); findings
+            # must report the spelling THIS run was invoked with. A MOVED
+            # file (same bytes, different module key) re-lowers instead —
+            # qualified names inside the summary would all be stale.
+            cached.path = path
+            return cached
+    summary = summarize_source(data.decode("utf-8"), path)
+    if cache_dir is not None:
+        store_cached_summary(cache_dir, digest, summary)
+    return summary
+
+
+@dataclass
+class Project:
+    """Whole-program view: module summaries + resolution indexes."""
+
+    modules: Dict[str, ModuleSummary] = field(default_factory=dict)  # by path
+    # "module::Class.method" -> summary
+    functions: Dict[str, FunctionSummary] = field(default_factory=dict)
+    by_name: Dict[str, List[FunctionSummary]] = field(default_factory=dict)
+    by_method: Dict[Tuple[str, str], List[FunctionSummary]] = field(
+        default_factory=dict
+    )
+
+    @staticmethod
+    def fqn(summary: FunctionSummary) -> str:
+        return f"{summary.module}::{summary.qualname}"
+
+    def add(self, mod: ModuleSummary) -> None:
+        self.modules[mod.path] = mod
+        for fn in mod.functions.values():
+            self.functions[self.fqn(fn)] = fn
+            self.by_name.setdefault(fn.name, []).append(fn)
+            if fn.cls:
+                self.by_method.setdefault((fn.cls, fn.name), []).append(fn)
+
+    @classmethod
+    def from_summaries(cls, summaries: Iterable[ModuleSummary]) -> "Project":
+        proj = cls()
+        for mod in summaries:
+            proj.add(mod)
+        return proj
+
+    @classmethod
+    def load(
+        cls, paths: Iterable[str], cache_dir: Optional[str] = None
+    ) -> "Project":
+        return cls.from_summaries(summarize_file(p, cache_dir) for p in paths)
+
+    # -- donor table --------------------------------------------------------
+
+    def jit_donors(self) -> Dict[str, Tuple[int, ...]]:
+        """Project-wide name/attr-tail -> donated positions: the StepLibrary
+        knowledge table plus every jit(..., donate_argnums=...) binding in
+        any module."""
+        from dynamic_load_balance_distributeddnn_tpu.analysis.rules import (
+            KNOWN_DONOR_ATTRS,
+        )
+
+        donors: Dict[str, Tuple[int, ...]] = dict(KNOWN_DONOR_ATTRS)
+        for mod in self.modules.values():
+            donors.update(mod.jit_donors)
+        return donors
+
+    def is_suppressed(self, mod: ModuleSummary, code: str, line: int) -> bool:
+        return code in mod.suppressions.get(line, frozenset())

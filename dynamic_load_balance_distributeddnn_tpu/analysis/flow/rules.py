@@ -1,0 +1,722 @@
+"""graftflow rules G011-G013: the whole-program bug classes.
+
+Each rule encodes an interprocedural/cross-thread incident this repo has
+actually shipped (single-file G001-G010 could not see any of them):
+
+* **G011 donation lifetime** — PR 6's review hardening found a LATENT
+  use-after-free shipped since the checkpoint seed: ``restore_checkpoint``
+  returned ``device_put(restored)`` (zero-copy alias of orbax-owned host
+  memory on the CPU backend) and the hot path later DONATED those leaves —
+  segfault in ``addressable_shards`` a few steps into the first post-resume
+  epoch, heap-layout dependent. The donating dispatch and the aliasing
+  ``device_put`` were two functions apart.
+* **G012 thread/lock discipline** — PR 5's review found ``service.close()``
+  racing the pool thread's ``_ensure_worker_pool``: pending jobs could
+  respawn-and-leak a worker pool close() had already shut down, because a
+  cross-thread attribute was mutated outside the lock the other thread
+  observed it under.
+* **G013 stale-mesh placement** — PR 6's elastic resume initially re-placed
+  the restored state with a sharding derived from the PRE-reshard mesh
+  (replicated over the full original device set): mixed-device crash at the
+  first combine. The mesh mutation (``_reshard_world``) and the stale
+  placement were in different functions.
+
+All three run on the :class:`~.project.Project` + :class:`~.callgraph.CallGraph`
+pair — no ASTs, only summaries — so the whole-program pass stays cacheable
+and cheap (tests/test_graftflow.py budgets the full-repo run).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.callgraph import CallGraph
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.ir import (
+    CallFact,
+    FunctionSummary,
+    ModuleSummary,
+    StmtFact,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import Project
+
+
+def _finding(code, path, line, col, message, fix_hint, symbol=""):
+    from dynamic_load_balance_distributeddnn_tpu.analysis.linter import Finding
+
+    return Finding(
+        code=code,
+        path=path,
+        line=line,
+        col=col,
+        message=message,
+        fix_hint=fix_hint,
+        symbol=symbol,
+    )
+
+
+def _mutually_exclusive(a: StmtFact, b: StmtFact) -> bool:
+    ga, gb = dict(a.guards), dict(b.guards)
+    return any(ga[k] != gb[k] for k in ga.keys() & gb.keys())
+
+
+def _reads_token(stmt: StmtFact, token: str) -> Optional[Tuple[str, int, int]]:
+    """A Load of ``token`` or of anything reached THROUGH it (prefix match:
+    donated ``self.state`` poisons ``self.state.params`` too)."""
+    pref = token + "."
+    for tok, line, col in stmt.reads:
+        if tok == token or tok.startswith(pref):
+            return (tok, line, col)
+    return None
+
+
+def _binds_token(stmt: StmtFact, token: str) -> bool:
+    return stmt.bind is not None and token in stmt.bind.targets
+
+
+class _FlowContext:
+    """Shared per-run state handed to every flow rule."""
+
+    def __init__(self, project: Project, graph: CallGraph):
+        self.project = project
+        self.graph = graph
+        self.path_by_module: Dict[str, str] = {
+            mod.module: path for path, mod in project.modules.items()
+        }
+        self.mod_by_module: Dict[str, ModuleSummary] = {
+            mod.module: mod for mod in project.modules.values()
+        }
+
+    def path_of(self, fn: FunctionSummary) -> str:
+        return self.path_by_module.get(fn.module, fn.module)
+
+    def suppressed(self, fn: FunctionSummary, code: str, line: int) -> bool:
+        mod = self.mod_by_module.get(fn.module)
+        return mod is not None and code in mod.suppressions.get(line, frozenset())
+
+
+# --------------------------------------------------------------------------
+# G011 — donation lifetime, whole-program
+
+
+class RuleG011:
+    code = "G011"
+    summary = (
+        "donated buffer (or an alias of it) live after the donating "
+        "dispatch — across assignments, containers, returns, self "
+        "attributes, and function boundaries"
+    )
+    fix_hint = (
+        "rebind every alias from the call's result, or force-copy before "
+        "donating (jnp.array(x, copy=True)) when the buffer's host memory "
+        "is owned elsewhere (checkpoint restore, numpy view) — XLA reuses "
+        "a donated buffer's storage, so any surviving reference is a "
+        "use-after-free (the pre-PR-6 restore_checkpoint->device_put shape)"
+    )
+
+    def check(self, ctx: _FlowContext) -> Iterator["Finding"]:
+        donors = ctx.project.jit_donors()
+        for fqn, fn in ctx.project.functions.items():
+            yield from self._check_function(ctx, fqn, fn, donors)
+
+    # -- alias groups -------------------------------------------------------
+
+    @staticmethod
+    def _alias_closure(
+        groups: Dict[str, Set[str]], token: str
+    ) -> Set[str]:
+        return set(groups.get(token, {token}))
+
+    def _check_function(
+        self,
+        ctx: _FlowContext,
+        fqn: str,
+        fn: FunctionSummary,
+        donors: Dict[str, Tuple[int, ...]],
+    ) -> Iterator["Finding"]:
+        graph = ctx.graph
+        path = ctx.path_of(fn)
+
+        # donation sites in source order: (stmt, call, token, kind)
+        # kind: "direct" (donor table — G005's beat, skipped for exact-token
+        # reads to avoid double reporting), "summary" (via callee), or
+        # "attr" (callee donates self.X)
+        sites: List[Tuple[StmtFact, CallFact, str, str]] = []
+        site_keys = set()
+        for stmt, call, tok, _line in graph._donation_sites(fn, donors):
+            kind = "direct" if donors.get(call.tail) or self._local_donor(
+                fn, call.tail
+            ) else "summary"
+            key = (id(stmt), id(call), tok)
+            if key not in site_keys:
+                site_keys.add(key)
+                sites.append((stmt, call, tok, kind))
+        # callee-donated self attributes: self.m() kills self.X
+        edge_by_call = {id(e.call): e for e in graph.edges.get(fqn, ())}
+        for stmt in fn.stmts:
+            for call in stmt.calls:
+                e = edge_by_call.get(id(call))
+                if e is None:
+                    continue
+                for attr in graph.donated_attrs.get(e.callee, ()):
+                    key = (id(stmt), id(call), attr)
+                    if key not in site_keys:
+                        site_keys.add(key)
+                        sites.append((stmt, call, attr, "attr"))
+        if not sites:
+            return
+
+        # forward alias groups at each statement index
+        stmts = list(fn.stmts)
+        index_of = {id(s): i for i, s in enumerate(stmts)}
+        groups: Dict[str, Set[str]] = {}
+        groups_at: List[Dict[str, Set[str]]] = []
+        for stmt in stmts:
+            # snapshot BEFORE the statement's own bind applies
+            groups_at.append({k: set(v) for k, v in groups.items()})
+            bind = stmt.bind
+            if bind is None:
+                continue
+            srcs: Set[str] = set()
+            if not bind.rhs_is_copy:
+                for tok in bind.alias_sources:
+                    srcs |= self._alias_closure(groups, tok)
+            for tgt in bind.targets:
+                # rebind: leave old group before joining the RHS's
+                for g in groups.values():
+                    g.discard(tgt)
+            if srcs:
+                # ONE group for all targets: `snap = keep = state` must
+                # leave snap/keep/state mutually aliased — per-target
+                # groups would evict earlier targets from later ones
+                new_group = srcs | set(bind.targets)
+                for member in new_group:
+                    groups[member] = new_group
+            else:
+                for tgt in bind.targets:
+                    groups.pop(tgt, None)
+
+        for stmt, call, token, kind in sites:
+            i = index_of.get(id(stmt))
+            if i is None:
+                continue
+            # the foreign-alias half: donating a buffer whose host memory is
+            # owned elsewhere is a finding AT the donation site, no read
+            # needed (the external owner IS the later reader)
+            yield from self._foreign_donation(
+                ctx, fn, path, stmt, call, token,
+                graph.origins_at(fn, stmt), edge_by_call,
+            )
+            killed = self._alias_closure(groups_at[i], token) | {token}
+            if stmt.bind is not None:
+                # x = f(x, ...) is the safe donate-and-rebind idiom — but
+                # only for the names actually rebound: an alias taken
+                # earlier (snap = x) still points at the donated buffer
+                killed -= set(stmt.bind.targets)
+            if not killed:
+                continue
+            for later in stmts[i + 1:]:
+                if _mutually_exclusive(stmt, later):
+                    continue
+                hit = None
+                for tok in killed:
+                    # exact-token reads of a direct donor are G005's finding;
+                    # G011 reports what single-file analysis cannot see
+                    read = _reads_token(later, tok)
+                    if read is not None and not (
+                        kind == "direct" and tok == token
+                    ):
+                        hit = (tok, read)
+                        break
+                if hit is not None:
+                    tok, (read_tok, line, col) = hit
+                    if ctx.suppressed(fn, self.code, line):
+                        break
+                    via = (
+                        f"`{call.name or call.tail}` (donates via its own "
+                        "dispatch)" if kind != "direct" else f"`{call.name or call.tail}`"
+                    )
+                    alias_note = (
+                        "" if tok == token else f" (aliases `{token}`)"
+                    )
+                    yield _finding(
+                        self.code,
+                        path,
+                        line,
+                        col,
+                        f"`{read_tok}`{alias_note} was donated to {via} on "
+                        f"line {call.line} and is read again here",
+                        self.fix_hint,
+                        symbol=f"{fn.module}::{fn.qualname}",
+                    )
+                    break
+                bound = set(later.bind.targets) if later.bind else set()
+                if bound & killed:
+                    killed -= bound
+                    if not killed:
+                        break
+
+    @staticmethod
+    def _local_donor(fn: FunctionSummary, tail: str) -> bool:
+        for stmt in fn.stmts:
+            if stmt.bind is not None and stmt.bind.donate_argnums:
+                if any(t.rsplit(".", 1)[-1] == tail for t in stmt.bind.targets):
+                    return True
+        return False
+
+    def _foreign_donation(
+        self, ctx, fn, path, stmt, call, token, origins, edge_by_call
+    ) -> Iterator["Finding"]:
+        graph = ctx.graph
+        for org in origins.get(token, frozenset()):
+            if org[0] != "call":
+                continue
+            # resolve the producing call to a summary with a foreign return
+            reason: Optional[str] = None
+            for e in graph.edges.get(Project.fqn(fn), ()):
+                if e.call.tail == org[1] and str(e.call.line) == org[3]:
+                    fr = graph.foreign_returns.get(e.callee)
+                    if fr is not None:
+                        reason = fr[1]
+                    break
+            if reason is None:
+                continue
+            if ctx.suppressed(fn, self.code, call.line):
+                continue
+            yield _finding(
+                self.code,
+                path,
+                call.line,
+                call.col,
+                f"`{token}` is donated to `{call.name or call.tail}` but "
+                f"aliases externally-owned host memory ({reason} without a "
+                "forced copy): donation frees storage the external owner "
+                "still holds — the pre-PR-6 restored-state use-after-free",
+                self.fix_hint,
+                symbol=f"{fn.module}::{fn.qualname}",
+            )
+            return
+
+
+# --------------------------------------------------------------------------
+# G012 — thread/lock discipline
+
+
+class RuleG012:
+    code = "G012"
+    summary = (
+        "cross-thread attribute mutated without a common lock, or a "
+        "lock-order cycle between package threads"
+    )
+    fix_hint = (
+        "guard every cross-thread access of the attribute with the SAME "
+        "lock (with self._lock: ...) — including the teardown path: the "
+        "pre-PR-5 close() respawn race was exactly a shutdown flag and a "
+        "pool handle mutated outside the lock the worker thread read them "
+        "under. For lock-order cycles, impose one global acquisition order"
+    )
+
+    # attrs whose cross-thread mutation is sanctioned bookkeeping (write-once
+    # publication of a thread/pool handle guarded by program order)
+    _HANDLE_TAILS = ("_thread",)
+
+    def check(self, ctx: _FlowContext) -> Iterator["Finding"]:
+        thread_side, main_side = ctx.graph.thread_sides()
+        if not thread_side:
+            return
+        yield from self._check_shared_attrs(ctx, thread_side, main_side)
+        yield from self._check_lock_cycles(ctx)
+
+    # -- unguarded cross-thread mutation ------------------------------------
+
+    def _check_shared_attrs(
+        self, ctx: _FlowContext, thread_side: Set[str], main_side: Set[str]
+    ) -> Iterator["Finding"]:
+        graph = ctx.graph
+        # (module, cls, attr) -> list of (fn, access, sides, eff_locks)
+        by_attr: Dict[Tuple[str, str, str], List] = {}
+        for fqn, fn in ctx.project.functions.items():
+            if not fn.cls or fn.is_setup:
+                continue
+            sides = set()
+            if fqn in thread_side:
+                sides.add("thread")
+            if fqn in main_side:
+                sides.add("main")
+            if not sides:
+                # unreachable from any entry we can see: treat as main-side
+                # API surface (errs toward coverage, not noise — it still
+                # needs BOTH sides present to matter)
+                sides.add("main")
+            env = graph.lock_env.get(fqn, frozenset())
+            mod = ctx.mod_by_module.get(fn.module)
+            lock_attrs = (
+                mod.lock_attrs.get(fn.cls, frozenset()) if mod else frozenset()
+            )
+            for stmt in fn.stmts:
+                for acc in stmt.attr_accesses:
+                    if acc.attr in lock_attrs:
+                        continue  # the locks themselves
+                    eff = (
+                        frozenset(
+                            t.split(".", 1)[1]
+                            for t in acc.locks
+                            if t.startswith("self.")
+                        )
+                        | env
+                    )
+                    by_attr.setdefault((fn.module, fn.cls, acc.attr), []).append(
+                        (fn, acc, frozenset(sides), eff)
+                    )
+        for (module, cls, attr), entries in sorted(
+            by_attr.items(), key=lambda kv: kv[0]
+        ):
+            if attr.endswith(self._HANDLE_TAILS):
+                continue
+            t_writes = [e for e in entries if "thread" in e[2] and e[1].write]
+            m_writes = [e for e in entries if "main" in e[2] and e[1].write]
+            t_all = [e for e in entries if "thread" in e[2]]
+            m_all = [e for e in entries if "main" in e[2]]
+            cross_mutated = (t_writes and m_all) or (m_writes and t_all)
+            if not cross_mutated:
+                continue
+            # the discipline: one common lock over EVERY cross-side access —
+            # reads included (a guarded writer with a bare reader on the
+            # other thread is still the PR-5 race shape)
+            cross = t_all + m_all
+            common = None
+            for e in cross:
+                common = e[3] if common is None else (common & e[3])
+            if common:
+                continue
+            # report ONE canonical site per attribute (bare sites first,
+            # then writes): an inline `# graftlint: disable=G012` there
+            # sanctions the whole attribute's discipline, and one finding
+            # per attr keeps the signal readable. A site guarded by SOME
+            # lock is still reportable — two sides each under a DIFFERENT
+            # lock share nothing and race all the same
+            ordered = sorted(
+                cross,
+                key=lambda e: (bool(e[3]), not e[1].write, e[0].module, e[1].line),
+            )
+            fn, acc, _sides, eff = ordered[0]
+            if ctx.suppressed(fn, self.code, acc.line):
+                continue  # the author acknowledged this attribute
+            held = (
+                f"holds only {sorted(eff)}, which the other side does not share"
+                if eff
+                else "holds no lock the other side shares"
+            )
+            yield _finding(
+                self.code,
+                ctx.path_of(fn),
+                acc.line,
+                acc.col,
+                f"`self.{attr}` is mutated across threads "
+                f"({cls}: thread-side "
+                f"{sorted({e[0].qualname for e in t_all if e[1].write}) or sorted({e[0].qualname for e in t_all})}"
+                f" vs main-side "
+                f"{sorted({e[0].qualname for e in m_all if e[1].write}) or sorted({e[0].qualname for e in m_all})})"
+                f" but this access in `{fn.qualname}` {held}",
+                self.fix_hint,
+                symbol=f"{module}::{cls}",
+            )
+
+    # -- lock-order cycles --------------------------------------------------
+
+    def _check_lock_cycles(self, ctx: _FlowContext) -> Iterator["Finding"]:
+        graph = ctx.graph
+        # class-scoped lock ids: (module, cls, lockattr)
+        edges: Dict[Tuple, Set[Tuple]] = {}
+        edge_site: Dict[Tuple[Tuple, Tuple], Tuple[str, int]] = {}
+
+        def lock_id(fn: FunctionSummary, token: str) -> Optional[Tuple]:
+            if token.startswith("self.") and fn.cls:
+                return (fn.module, fn.cls, token.split(".", 1)[1])
+            return None
+
+        acquired: Dict[str, Set[Tuple]] = {}
+        for fqn, fn in ctx.project.functions.items():
+            acq = {
+                lid
+                for stmt in fn.stmts
+                for t in stmt.locks
+                for lid in [lock_id(fn, t)]
+                if lid is not None
+            }
+            acquired[fqn] = acq
+            for o, i in fn.lock_order_edges:
+                lo, li = lock_id(fn, o), lock_id(fn, i)
+                if lo is not None and li is not None and lo != li:
+                    edges.setdefault(lo, set()).add(li)
+                    edge_site.setdefault((lo, li), (ctx.path_of(fn), fn.line))
+        # interprocedural: caller holds L at a call site whose callee
+        # acquires M
+        for fqn, fn in ctx.project.functions.items():
+            for e in graph.edges.get(fqn, ()):
+                held = {
+                    lid
+                    for t in e.call.locks
+                    for lid in [lock_id(fn, t)]
+                    if lid is not None
+                }
+                for m in acquired.get(e.callee, ()):
+                    for h in held:
+                        if h != m:
+                            edges.setdefault(h, set()).add(m)
+                            edge_site.setdefault(
+                                (h, m), (ctx.path_of(fn), e.call.line)
+                            )
+        # cycle detection. `seen` is per-START: a shared edge set would let
+        # a cycle-free traversal from one start mark edges visited and hide
+        # a real cycle among them from every later start
+        reported: Set[FrozenSet] = set()
+        for start in sorted(edges):
+            seen: Set[Tuple] = set()
+            stack = [(start, [start])]
+            while stack:
+                node, path_ = stack.pop()
+                for nxt in sorted(edges.get(node, ())):
+                    if nxt == start and len(path_) > 1:
+                        cyc = frozenset(path_)
+                        if cyc in reported:
+                            continue
+                        reported.add(cyc)
+                        fpath, line = edge_site.get(
+                            (path_[-1], start), ("<unknown>", 0)
+                        )
+                        names = " -> ".join(
+                            f"{c}.{a}" for (_m, c, a) in path_ + [start]
+                        )
+                        yield _finding(
+                            self.code,
+                            fpath,
+                            line,
+                            0,
+                            f"lock-order cycle: {names} — two threads "
+                            "taking these locks in opposite order deadlock",
+                            self.fix_hint,
+                            symbol=f"{start[0]}::{start[1]}",
+                        )
+                    elif nxt not in path_ and (node, nxt) not in seen:
+                        seen.add((node, nxt))
+                        stack.append((nxt, path_ + [nxt]))
+
+
+# --------------------------------------------------------------------------
+# G013 — stale-mesh placement
+
+
+class RuleG013:
+    code = "G013"
+    summary = (
+        "placement/sharding/executable derived from a mesh a reachable "
+        "re-shard can invalidate, without _aot_gen keying or rebuild"
+    )
+    fix_hint = (
+        "rebuild the sharding from self.mesh AT the placement site (after "
+        "any possible re-shard), key registry lookups with the _aot_gen "
+        "generation counter, and make the re-shard method invalidate every "
+        "mesh-derived cache it leaves behind — the pre-PR-6 "
+        "restore-onto-old-mesh crash was a sharding captured before "
+        "_reshard_world rebuilt the mesh"
+    )
+
+    _MESH_ATTRS = {"mesh", "_mesh"}
+    _GEN_MARKERS = {"_aot_gen", "aot_gen", "generation"}
+    _PLACEMENT_TAILS = {
+        "device_put",
+        "device_put_sharded",
+        "device_put_replicated",
+        "NamedSharding",
+    }
+    _RESHARD_MARKERS = ("reshard", "_reshard")
+
+    def check(self, ctx: _FlowContext) -> Iterator["Finding"]:
+        graph = ctx.graph
+        # mesh mutators: non-setup functions that rebind a mesh attr
+        mutators: List[str] = []
+        for fqn, fn in ctx.project.functions.items():
+            if fn.is_setup or not fn.cls:
+                continue
+            for stmt in fn.stmts:
+                for acc in stmt.attr_accesses:
+                    if acc.write and acc.attr in self._MESH_ATTRS:
+                        mutators.append(fqn)
+                        break
+                else:
+                    continue
+                break
+        if not mutators:
+            return
+        mutator_set = set(mutators)
+        # functions from which a mutator is reachable (reverse reachability)
+        can_reshard: Set[str] = set(mutator_set)
+        frontier = list(mutator_set)
+        while frontier:
+            cur = frontier.pop()
+            for e in graph.callers.get(cur, ()):
+                if e.caller not in can_reshard:
+                    can_reshard.add(e.caller)
+                    frontier.append(e.caller)
+
+        yield from self._check_stale_attrs(ctx, mutator_set)
+        yield from self._check_local_staleness(ctx, can_reshard, mutator_set)
+
+    # -- class invariant: mesh-derived attrs the re-shard never invalidates -
+
+    def _check_stale_attrs(
+        self, ctx: _FlowContext, mutators: Set[str]
+    ) -> Iterator["Finding"]:
+        graph = ctx.graph
+        # per class: which attrs do the mutators (incl. their callees AND
+        # their direct callers — the engine's contract is "_reshard_world
+        # leaves state placement to its caller", so the orchestrating
+        # _recover/_maybe_restore re-bindings count as invalidation) rebind?
+        by_class: Dict[Tuple[str, str], Set[str]] = {}
+        for m in mutators:
+            fn = ctx.project.functions[m]
+            invalidated = by_class.setdefault((fn.module, fn.cls), set())
+            roots = [m] + [e.caller for e in graph.callers.get(m, ())]
+            for reach in graph.reachable(roots, spawn_too=False):
+                rfn = ctx.project.functions[reach]
+                for stmt in rfn.stmts:
+                    for acc in stmt.attr_accesses:
+                        if acc.write:
+                            invalidated.add(acc.attr)
+        for fqn, fn in ctx.project.functions.items():
+            key = (fn.module, fn.cls)
+            if key not in by_class:
+                continue  # class without a mesh mutator
+            invalidated = by_class[key]
+            for stmt in fn.stmts:
+                for acc in stmt.attr_accesses:
+                    if not acc.write or acc.attr in self._MESH_ATTRS:
+                        continue
+                    if not (acc.rhs_idents & self._MESH_ATTRS):
+                        continue
+                    if acc.rhs_idents & self._GEN_MARKERS:
+                        continue  # generation-keyed: stale entries can't hit
+                    if acc.attr in invalidated:
+                        continue
+                    if not self._read_elsewhere(ctx, fn, acc.attr):
+                        continue
+                    if ctx.suppressed(fn, self.code, acc.line):
+                        continue
+                    yield _finding(
+                        self.code,
+                        ctx.path_of(fn),
+                        acc.line,
+                        acc.col,
+                        f"`self.{acc.attr}` is derived from the mesh in "
+                        f"`{fn.qualname}` but no re-shard path rebinds it: "
+                        "after a mesh mutation every later use places onto "
+                        "the OLD device set",
+                        self.fix_hint,
+                        symbol=f"{fn.module}::{fn.cls}",
+                    )
+
+    @staticmethod
+    def _read_elsewhere(ctx, writer: FunctionSummary, attr: str) -> bool:
+        for other in ctx.project.functions.values():
+            if other.cls != writer.cls or other.module != writer.module:
+                continue
+            if other.qualname == writer.qualname:
+                continue
+            for stmt in other.stmts:
+                for acc in stmt.attr_accesses:
+                    if acc.attr == attr and not acc.write:
+                        return True
+        return False
+
+    # -- local staleness: mesh captured, re-shard possible, stale use -------
+
+    def _check_local_staleness(
+        self, ctx: _FlowContext, can_reshard: Set[str], mutators: Set[str]
+    ) -> Iterator["Finding"]:
+        graph = ctx.graph
+        for fqn, fn in ctx.project.functions.items():
+            if fqn in mutators:
+                continue
+            edge_by_call = {id(e.call): e for e in graph.edges.get(fqn, ())}
+            stmts = list(fn.stmts)
+            # mesh-derived locals: bound from an expression mentioning a
+            # mesh attr (and not generation-keyed)
+            derived: Dict[str, int] = {}  # token -> bind stmt index
+            reshard_at: Optional[int] = None
+            for i, stmt in enumerate(stmts):
+                # stale use BEFORE considering this stmt's own binds
+                if reshard_at is not None:
+                    for call in stmt.calls:
+                        if call.tail not in self._PLACEMENT_TAILS:
+                            continue
+                        used = None
+                        for idents in list(call.arg_idents) + [
+                            ids for _k, ids in call.kwarg_idents
+                        ]:
+                            for tok, at in derived.items():
+                                if at < reshard_at and tok in idents:
+                                    used = tok
+                                    break
+                            if used:
+                                break
+                        if used is None:
+                            continue
+                        if ctx.suppressed(fn, self.code, call.line):
+                            continue
+                        yield _finding(
+                            self.code,
+                            ctx.path_of(fn),
+                            call.line,
+                            call.col,
+                            f"`{used}` captures the mesh before the "
+                            f"re-shard on line {stmts[reshard_at].line} "
+                            f"can rebuild it, then `{call.tail}` places "
+                            "with the STALE capture — the pre-PR-6 "
+                            "restore-onto-old-mesh shape",
+                            self.fix_hint,
+                            symbol=f"{fn.module}::{fn.qualname}",
+                        )
+                        derived.pop(used, None)
+                if stmt.bind is not None:
+                    idents = stmt.bind.rhs_idents
+                    for tgt in stmt.bind.targets:
+                        if (
+                            idents & self._MESH_ATTRS
+                            and not idents & self._GEN_MARKERS
+                            and "." not in tgt
+                        ):
+                            derived[tgt] = i
+                        else:
+                            derived.pop(tgt, None)
+                for call in stmt.calls:
+                    e = edge_by_call.get(id(call))
+                    hits_reshard = (
+                        e is not None and e.callee in can_reshard
+                    ) or any(m in call.tail for m in self._RESHARD_MARKERS)
+                    if hits_reshard and reshard_at is None:
+                        reshard_at = i
+
+
+FLOW_RULES: Dict[str, object] = {
+    r.code: r for r in (RuleG011(), RuleG012(), RuleG013())
+}
+
+
+def run_flow_rules(
+    project: Project,
+    graph: Optional[CallGraph] = None,
+    select: Optional[Sequence[str]] = None,
+) -> List["Finding"]:
+    wanted = set(select) if select is not None else None
+    if wanted is not None and not (wanted & set(FLOW_RULES)):
+        return []  # nothing selected: skip the whole-program pass entirely
+    if graph is None:
+        graph = CallGraph(project)
+    ctx = _FlowContext(project, graph)
+    findings: List = []
+    for code, rule in FLOW_RULES.items():
+        if wanted is not None and code not in wanted:
+            continue
+        findings.extend(rule.check(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings

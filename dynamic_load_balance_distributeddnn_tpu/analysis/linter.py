@@ -1,19 +1,34 @@
 """graftlint driver: parse each file once, hand the module to every rule,
 collect findings.
 
-The linter is repo-specific by design (ISSUE: the bug classes it encodes are
-the ones this repo shipped and fixed — see README "Static analysis"), so the
-rules are allowed to know idioms like ``self.steps.worker_step_first`` and
-``snap_to_bucket``. No import resolution, no type inference: a rule either
-matches a structural pattern in one module or stays quiet.
+Two analysis tiers share this driver:
+
+* **single-file rules** (rules.py G001-G010): a rule either matches a
+  structural pattern in one module or stays quiet — no import resolution,
+  no type inference.
+* **whole-program flow rules** (flow/ G011-G013, ``flow=True``): every file
+  is lowered to a picklable summary, a call graph propagates facts across
+  functions/threads/modules, and the flow rules check donation lifetimes,
+  thread/lock discipline, and stale-mesh placement.
+
+Both tiers are **content-hash cached** (per-file findings and per-module
+summaries keyed by sha256) and the per-file work fans out over a process
+pool (``jobs``) — a warm full-repo ``--flow`` run costs file hashing plus
+one in-process call-graph pass. The linter is repo-specific by design (the
+bug classes it encodes are the ones this repo shipped and fixed — see README
+"Static analysis"), so rules are allowed to know idioms like
+``self.steps.worker_step_first`` and ``snap_to_bucket``.
 """
 
 from __future__ import annotations
 
 import ast
+import concurrent.futures
+import dataclasses
 import os
+import pickle
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from dynamic_load_balance_distributeddnn_tpu.analysis import rules as _rules
 from dynamic_load_balance_distributeddnn_tpu.analysis.astutil import (
@@ -21,11 +36,16 @@ from dynamic_load_balance_distributeddnn_tpu.analysis.astutil import (
     suppressed_rules,
 )
 
+# Bump on ANY rule/semantics change: stale cached findings must miss.
+LINT_SCHEMA_VERSION = "gl2"
+
 
 @dataclass(frozen=True)
 class Finding:
     """One rule violation. ``fix_hint`` is the rule's canned autofix advice —
-    graftlint never rewrites code, it tells you the one-line remedy."""
+    graftlint never rewrites code, it tells you the one-line remedy.
+    ``symbol`` (``module::qualname``, flow rules only) is the stable anchor
+    the baseline file matches on."""
 
     code: str
     path: str
@@ -33,6 +53,7 @@ class Finding:
     col: int
     message: str
     fix_hint: str
+    symbol: str = ""
 
     def format(self) -> str:
         return (
@@ -43,7 +64,7 @@ class Finding:
 
 @dataclass
 class ModuleContext:
-    """Everything a rule needs about one parsed file."""
+    """Everything a single-file rule needs about one parsed file."""
 
     path: str
     source: str
@@ -76,7 +97,7 @@ def lint_source(
     path: str = "<string>",
     select: Optional[Iterable[str]] = None,
 ) -> List[Finding]:
-    """Run every (or the selected) rule over one source string."""
+    """Run every (or the selected) single-file rule over one source string."""
     ctx = ModuleContext.from_source(source, path=path)
     wanted = set(select) if select is not None else None
     findings: List[Finding] = []
@@ -114,12 +135,127 @@ def _iter_py_files(path: str) -> Iterable[str]:
                 yield os.path.join(root, name)
 
 
-def lint_paths(
-    paths: Iterable[str], select: Optional[Iterable[str]] = None
-) -> List[Finding]:
-    """Lint files and/or package directories (recursive)."""
-    findings: List[Finding] = []
+def expand_paths(paths: Iterable[str]) -> List[str]:
+    files: List[str] = []
     for path in paths:
-        for file_path in _iter_py_files(path):
-            findings.extend(lint_file(file_path, select=select))
+        files.extend(_iter_py_files(path))
+    return files
+
+
+# ------------------------------------------------------------- cached worker
+
+
+def _findings_cache_key(digest: str, select_key: str) -> str:
+    return f"{digest}-{LINT_SCHEMA_VERSION}-{select_key}.lint"
+
+
+def _select_key(select: Optional[Sequence[str]]) -> str:
+    return "all" if select is None else "-".join(sorted(select))
+
+
+def _lint_one(
+    path: str,
+    select: Optional[Sequence[str]],
+    cache_dir: Optional[str],
+    with_summary: bool,
+) -> Tuple[List[Finding], Optional[object]]:
+    """One file's single-file findings + (optionally) its flow summary,
+    both through the content-hash cache. Top-level so a process pool can
+    ship it."""
+    from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import (
+        _ensure_private_dir,
+        content_hash,
+        summarize_file,
+    )
+
+    with open(path, "rb") as fh:
+        data = fh.read()
+    digest = content_hash(data)
+    findings: Optional[List[Finding]] = None
+    if cache_dir is not None:
+        fpath = os.path.join(
+            cache_dir, _findings_cache_key(digest, _select_key(select))
+        )
+        try:
+            with open(fpath, "rb") as fh:
+                cached = pickle.load(fh)
+            if isinstance(cached, list):
+                findings = [dataclasses.replace(f, path=path) for f in cached]
+        except (OSError, pickle.UnpicklingError, EOFError, AttributeError):
+            findings = None
+    if findings is None:
+        findings = lint_source(data.decode("utf-8"), path=path, select=select)
+        if cache_dir is not None:
+            try:
+                _ensure_private_dir(cache_dir)
+                tmp = fpath + f".tmp{os.getpid()}"
+                with open(tmp, "wb") as fh:
+                    pickle.dump(findings, fh)
+                os.replace(tmp, fpath)
+            except OSError:
+                pass
+    summary = (
+        summarize_file(path, cache_dir, data=data) if with_summary else None
+    )
+    return findings, summary
+
+
+def _auto_jobs(n_files: int) -> int:
+    if n_files < 8:
+        return 1  # pool spawn costs more than it saves on tiny runs
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+def lint_paths(
+    paths: Iterable[str],
+    select: Optional[Sequence[str]] = None,
+    jobs: int = 0,
+    cache_dir: Optional[str] = None,
+    flow: bool = False,
+    flow_select: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint files and/or package directories (recursive).
+
+    ``jobs``: 0 = auto (process-parallel above a handful of files), 1 =
+    serial, N = pool width. ``cache_dir``: content-hash cache for per-file
+    findings and flow summaries (None disables). ``flow``: additionally run
+    the whole-program rules (G011-G013) over ALL the files as one program.
+    """
+    from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import (
+        Project,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.analysis.flow.rules import (
+        run_flow_rules,
+    )
+
+    files = expand_paths(paths)
+    n_jobs = jobs if jobs > 0 else _auto_jobs(len(files))
+    results: List[Tuple[List[Finding], Optional[object]]] = []
+    if n_jobs <= 1 or len(files) <= 1:
+        for f in files:
+            results.append(_lint_one(f, select, cache_dir, flow))
+    else:
+        import multiprocessing
+
+        # spawn, never fork: the linter is often invoked from a process
+        # with live jax/XLA threads (the tier-1 gate), and forking a
+        # threaded parent can deadlock on locks held mid-fork; the package
+        # import is jax-free and costs ~30 ms per worker
+        with concurrent.futures.ProcessPoolExecutor(
+            max_workers=n_jobs, mp_context=multiprocessing.get_context("spawn")
+        ) as ex:
+            futs = [
+                ex.submit(_lint_one, f, select, cache_dir, flow) for f in files
+            ]
+            results = [fut.result() for fut in futs]
+    findings: List[Finding] = []
+    summaries = []
+    for file_findings, summary in results:
+        findings.extend(file_findings)
+        if summary is not None:
+            summaries.append(summary)
+    if flow:
+        project = Project.from_summaries(summaries)
+        findings.extend(run_flow_rules(project, select=flow_select))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings

@@ -42,6 +42,16 @@ def _finding(code, ctx, node, message, fix_hint):
     )
 
 
+def Finding_at(code, ctx, line, col, message, fix_hint):
+    """_finding for IR facts, which carry (line, col) instead of AST nodes."""
+    from dynamic_load_balance_distributeddnn_tpu.analysis.linter import Finding
+
+    return Finding(
+        code=code, path=ctx.path, line=line, col=col,
+        message=message, fix_hint=fix_hint,
+    )
+
+
 # --------------------------------------------------------------------------
 # Shared repo knowledge
 
@@ -735,6 +745,12 @@ class RuleG004:
 
 # --------------------------------------------------------------------------
 # G005 — donated buffer referenced after the donating call
+#
+# Since ISSUE 8 this rule runs on the graftflow IR (analysis/flow/ir.py):
+# the statement flattening, branch-exclusivity guards, and token read/bind
+# checks are the same machinery G011 propagates interprocedurally — G005
+# stays the fast single-file tier (exact donated token, direct donor call),
+# G011 adds aliases/containers/returns/self-attrs across functions.
 
 
 class RuleG005:
@@ -746,167 +762,64 @@ class RuleG005:
         "by XLA and reading it is undefined (DeletedBuffer on TPU)"
     )
 
-    def _donors(self, ctx) -> Dict[str, Tuple[int, ...]]:
-        """name/attr-tail -> donated argnums, from same-file jit(...,
-        donate_argnums=...) bindings and the StepLibrary knowledge table."""
-        donors = dict(KNOWN_DONOR_ATTRS)
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                if is_jit_construction(node.value):
-                    nums = literal_int_tuple(jit_kwarg(node.value, "donate_argnums"))
-                    if nums:
-                        for t in node.targets:
-                            name = dotted_name(t)
-                            if name:
-                                donors[_attr_tail(name)] = nums
-            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    if isinstance(dec, ast.Call) and is_jit_construction(dec):
-                        nums = literal_int_tuple(jit_kwarg(dec, "donate_argnums"))
-                        if nums:
-                            donors[node.name] = nums
-        return donors
-
-    @staticmethod
-    def _stmt_list(fn: ast.AST, ctx) -> List[ast.stmt]:
-        """All statements whose innermost function is ``fn``, source order."""
-        stmts = [
-            n
-            for n in ast.walk(fn)
-            if isinstance(n, ast.stmt)
-            and n is not fn
-            and _innermost_function(n, ctx.parents) is fn
-        ]
-        return sorted(stmts, key=lambda s: (s.lineno, s.col_offset))
-
-    @staticmethod
-    def _shallow_walk(stmt: ast.stmt):
-        """``stmt`` and its non-statement descendants. Nested statements are
-        NOT entered: each appears in the flattened statement list on its own
-        turn, so scanning them here would read a compound statement's body
-        before its own inner rebinds are considered."""
-        stack: List[ast.AST] = [stmt]
-        while stack:
-            node = stack.pop()
-            yield node
-            for child in ast.iter_child_nodes(node):
-                if not isinstance(child, ast.stmt):
-                    stack.append(child)
-
-    @classmethod
-    def _reads_token(cls, stmt: ast.stmt, token: str) -> Optional[ast.AST]:
-        for n in cls._shallow_walk(stmt):
-            if dotted_name(n) == token and isinstance(
-                getattr(n, "ctx", None), ast.Load
-            ):
-                return n
-        return None
-
-    @staticmethod
-    def _binds_token(stmt: ast.stmt, token: str) -> bool:
-        if token in assign_targets(stmt):
-            return True
-
-        def flat(t: ast.expr):
-            if isinstance(t, (ast.Tuple, ast.List)):
-                for e in t.elts:
-                    yield from flat(e)
-            elif isinstance(t, ast.Starred):
-                yield from flat(t.value)
-            else:
-                yield t
-
-        if isinstance(stmt, ast.Assign):
-            return any(
-                dotted_name(e) == token for t in stmt.targets for e in flat(t)
-            )
-        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
-            return dotted_name(stmt.target) == token
-        return False
-
-    @staticmethod
-    def _nearest_stmt(node: ast.AST, parents) -> Optional[ast.stmt]:
-        cur = parents.get(node)
-        while cur is not None and not isinstance(cur, ast.stmt):
-            cur = parents.get(cur)
-        return cur if isinstance(cur, ast.stmt) else None
-
-    @staticmethod
-    def _mutually_exclusive(a: ast.AST, b: ast.AST, parents) -> bool:
-        """True when ``a`` and ``b`` sit in different arms of the same If —
-        they can never both execute, so a donate in one arm does not poison
-        a read in the other (keeps the zero-noise contract on the common
-        donate-in-early-return-branch pattern)."""
-        chain_a: List[ast.AST] = []
-        n: Optional[ast.AST] = a
-        while n is not None:
-            chain_a.append(n)
-            n = parents.get(n)
-        index_a = {id(x): i for i, x in enumerate(chain_a)}
-        n, prev_b = b, b
-        while n is not None and id(n) not in index_a:
-            prev_b = n
-            n = parents.get(n)
-        if n is None or not isinstance(n, ast.If):
-            return False
-        i = index_a[id(n)]
-        prev_a = chain_a[i - 1] if i > 0 else a
-
-        def arm(child: ast.AST) -> Optional[str]:
-            if any(child is s for s in n.body):
-                return "body"
-            if any(child is s for s in n.orelse):
-                return "orelse"
-            return None
-
-        arm_a, arm_b = arm(prev_a), arm(prev_b)
-        return arm_a is not None and arm_b is not None and arm_a != arm_b
-
     def check(self, ctx) -> Iterator["Finding"]:
-        donors = self._donors(ctx)
-        fns = [
-            n
-            for n in ast.walk(ctx.tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-        ]
-        for fn in fns:
-            stmts = self._stmt_list(fn, ctx)
-            index_of = {id(s): i for i, s in enumerate(stmts)}
-            for node in _function_calls(fn, ctx.parents):
-                stmt = self._nearest_stmt(node, ctx.parents)
-                i = index_of.get(id(stmt))
-                if i is None:
-                    continue
-                nums = donors.get(_attr_tail(call_name(node)))
-                if not nums:
-                    continue
-                for argnum in nums:
-                    if argnum >= len(node.args):
+        from dynamic_load_balance_distributeddnn_tpu.analysis.flow.ir import (
+            summarize_module,
+        )
+        from dynamic_load_balance_distributeddnn_tpu.analysis.flow.rules import (
+            _mutually_exclusive,
+            _reads_token,
+        )
+
+        mod = summarize_module(
+            ctx.tree, path=ctx.path, module="<single>", parents=ctx.parents
+        )
+        donors: Dict[str, Tuple[int, ...]] = dict(KNOWN_DONOR_ATTRS)
+        donors.update(mod.jit_donors)
+        for fn in mod.functions.values():
+            stmts = list(fn.stmts)
+            # locals bound to jit(..., donate_argnums=...) in this function
+            local_donors = dict(donors)
+            for stmt in stmts:
+                if stmt.bind is not None and stmt.bind.donate_argnums:
+                    for t in stmt.bind.targets:
+                        local_donors[t.rsplit(".", 1)[-1]] = (
+                            stmt.bind.donate_argnums
+                        )
+            for i, stmt in enumerate(stmts):
+                for call in stmt.calls:
+                    nums = local_donors.get(call.tail)
+                    if not nums:
                         continue
-                    token = dotted_name(node.args[argnum])
-                    if token is None:
-                        continue
-                    # donated-and-rebound in the same statement is the
-                    # safe idiom: state = f(state, ...)
-                    if self._binds_token(stmt, token):
-                        continue
-                    for later in stmts[i + 1:]:
-                        if self._mutually_exclusive(stmt, later, ctx.parents):
+                    for argnum in nums:
+                        if argnum >= len(call.args):
                             continue
-                        read = self._reads_token(later, token)
-                        if read is not None:
-                            yield _finding(
-                                self.code,
-                                ctx,
-                                read,
-                                f"`{token}` was donated to "
-                                f"`{call_name(node)}` on line "
-                                f"{node.lineno} and read again here",
-                                self.fix_hint,
-                            )
-                            break
-                        if self._binds_token(later, token):
-                            break
+                        token = call.args[argnum]
+                        if token is None:
+                            continue
+                        # donated-and-rebound in the same statement is the
+                        # safe idiom: state = f(state, ...)
+                        if stmt.bind is not None and token in stmt.bind.targets:
+                            continue
+                        for later in stmts[i + 1:]:
+                            if _mutually_exclusive(stmt, later):
+                                continue
+                            read = _reads_token(later, token)
+                            if read is not None:
+                                read_tok, line, col = read
+                                yield Finding_at(
+                                    self.code,
+                                    ctx,
+                                    line,
+                                    col,
+                                    f"`{token}` was donated to "
+                                    f"`{call.name or call.tail}` on line "
+                                    f"{call.line} and read again here",
+                                    self.fix_hint,
+                                )
+                                break
+                            if later.bind is not None and token in later.bind.targets:
+                                break
 
 
 # --------------------------------------------------------------------------

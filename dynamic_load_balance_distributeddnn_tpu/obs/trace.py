@@ -140,7 +140,13 @@ class Tracer:
         if mode not in ("off", "on", "ring"):
             raise ValueError(f"trace mode must be 'off', 'on' or 'ring', got {mode!r}")
         self.mode = mode
-        self.enabled = mode != "off"
+        # deliberately unlocked: `enabled` is a write-once-per-configure
+        # bool read by every span() call on pipeline/compile-pool threads —
+        # the DISABLED-mode contract is ONE attribute check with zero
+        # allocations, and a momentarily stale read only drops/keeps one
+        # span around a reconfigure (configure happens at run boundaries,
+        # never under live traffic)
+        self.enabled = mode != "off"  # graftlint: disable=G012
         self._jax_bridge = bool(jax_annotations) and self.enabled
         # deque.append is atomic under the GIL — pipeline/compile-pool
         # threads emit without a lock on the hot path

@@ -309,8 +309,9 @@ class CompileWorkerPool:
             try:
                 job_id, worker_id, wall, err = self._ack_q.get(timeout=0.5)
             except queue.Empty:
-                if self._dead:
-                    return
+                with self._lock:
+                    if self._dead:
+                        return
                 alive = self.alive()
                 if 0 < alive < last_alive:
                     # SOME worker died mid-job (OOM kill, segfault). The
@@ -352,10 +353,11 @@ class CompileWorkerPool:
                         self._dead = True
                         self._ready.set()
                         self._all_ready.set()
+                        ready_count = self._ready_count
                     if self._logger is not None:
                         self._logger.warning(
                             f"compile worker pool died before serving any "
-                            f"acks ({self._ready_count}/{self._workers} "
+                            f"acks ({ready_count}/{self._workers} "
                             "workers reached ready); every job compiles "
                             "in-process — common cause: a __main__ the "
                             "spawned interpreter cannot re-import"
@@ -404,7 +406,8 @@ class CompileWorkerPool:
 
     @property
     def startup_s(self) -> Optional[float]:
-        return self._startup_s
+        with self._lock:
+            return self._startup_s
 
     def alive(self) -> int:
         return sum(1 for p in self._procs if p.is_alive())

@@ -286,7 +286,8 @@ class AOTCompileService:
                 self._worker_pool = pool
                 return pool
         pool.shutdown()  # lost the race to a concurrent spawner
-        return self._worker_pool
+        with self._lock:
+            return self._worker_pool
 
     def _offload_to_worker(self, key: Hashable, lowered, tr, key_args) -> None:
         """Process backend: ship the lowered program to a worker and wait
@@ -419,7 +420,10 @@ class AOTCompileService:
     def get(self, key: Hashable):
         """Finished ``Compiled`` for ``key``, or None (absent / in flight /
         failed). Non-blocking — the dispatch-time resolution path."""
-        return self._done.get(key)
+        # deliberately lock-free: this sits on the per-step dispatch path;
+        # dict.get is GIL-atomic and a racy miss only means one lazy-jit
+        # fallback dispatch (bitwise-identical), never a wrong executable
+        return self._done.get(key)  # graftlint: disable=G012
 
     def wait(
         self,

@@ -1,0 +1,40 @@
+"""G011 negatives: the sanctioned donation idioms must stay quiet.
+
+* restore with a FORCED copy before the device_put (the PR-6 fix shape)
+* donate-and-rebind with no surviving alias
+* donation in one If arm, read in the other (mutually exclusive)
+"""
+
+import jax
+import jax.numpy as jnp
+
+update = jax.jit(lambda state, grads: state - 0.1 * grads, donate_argnums=(0,))
+
+
+def restore_checkpoint(mgr, step, sharding):
+    restored = mgr.restore(step)
+    # forced copy into a jax-owned buffer: donation-safe
+    return jax.device_put(jnp.array(restored, copy=True), sharding)
+
+
+def resume_and_step(mgr, step, sharding, grads):
+    state = restore_checkpoint(mgr, step, sharding)
+    state = update(state, grads)
+    return state
+
+
+def apply(state, grads):
+    return update(state, grads)
+
+
+def outer(state, grads):
+    new = apply(state, grads)
+    return new
+
+
+def branches(state, grads, fast):
+    if fast:
+        out = update(state, grads)
+    else:
+        out = jnp.sum(state)  # other arm: the donate can't have run
+    return out

@@ -1,0 +1,46 @@
+"""G011 seed: the pre-PR-6 donated-restore use-after-free, minimized.
+
+Shape 1 (the shipped bug): ``restore_checkpoint`` returns
+``device_put(restored)`` — on the CPU backend a ZERO-COPY alias of host
+memory the checkpoint machinery owns — and the caller donates that value to
+a hot-path dispatch. Donation frees storage the external owner still holds:
+segfault in ``addressable_shards`` a few steps later, heap-layout dependent.
+
+Shape 2: donation happens inside a callee (``apply``), the read in the
+caller — invisible to single-file G005.
+
+Shape 3: an alias (``snap = state``) taken before a donate-and-rebind; the
+rebound name is fresh but the alias still points at the donated buffer.
+"""
+
+import jax
+import jax.numpy as jnp
+
+update = jax.jit(lambda state, grads: state - 0.1 * grads, donate_argnums=(0,))
+
+
+def restore_checkpoint(mgr, step, sharding):
+    restored = mgr.restore(step)  # orbax owns these host buffers
+    return jax.device_put(restored, sharding)  # zero-copy alias on CPU
+
+
+def resume_and_step(mgr, step, sharding, grads):
+    state = restore_checkpoint(mgr, step, sharding)
+    return update(state, grads)  # donates the externally-aliased buffer
+
+
+def apply(state, grads):
+    return update(state, grads)  # donates its param 0
+
+
+def outer(state, grads):
+    new = apply(state, grads)  # `state` dies in the callee
+    drift = jnp.abs(state - new).max()  # read of the donated buffer
+    return new, drift
+
+
+def window(state, grads_seq):
+    snap = state  # alias of the original buffer
+    for g in grads_seq:
+        state = update(state, g)  # donate-and-rebind: `state` is fresh...
+    return state, jnp.sum(snap)  # ...but `snap` still points at round 0

@@ -1,0 +1,41 @@
+"""G012 negatives: the disciplined twin of the drain-race fixture.
+
+Every cross-thread access of ``_pool``/``_stopped`` holds ``self._lock`` —
+including interprocedurally: ``_ensure_pool_locked`` itself takes no lock,
+but its only call sites hold it, so the callgraph's lock environment proves
+its writes guarded (the compiler.py ``_ensure_pool_locked`` idiom).
+"""
+
+import threading
+
+
+class CompileService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool = None
+        self._stopped = False
+        self._feeder_thread = threading.Thread(target=self._feeder, daemon=True)
+        self._feeder_thread.start()
+
+    def _ensure_pool_locked(self):
+        # callers hold self._lock (lock-env propagation, not lexical)
+        if self._pool is None:
+            self._pool = _spawn_pool()
+        return self._pool
+
+    def _feeder(self):
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                pool = self._ensure_pool_locked()
+            pool.feed()
+
+    def close(self):
+        with self._lock:
+            self._stopped = True
+            self._pool = None
+
+
+def _spawn_pool():
+    return object()

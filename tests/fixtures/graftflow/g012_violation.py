@@ -1,0 +1,37 @@
+"""G012 seed: the pre-PR-5 compile-service drain race, minimized.
+
+The shipped shape: ``close()`` mutates the worker-pool handle and the
+shutdown flag on the main thread with NO lock, while the feeder thread
+reads the flag and re-creates the pool through ``_ensure_pool`` — a pending
+job racing the drain respawns a pool that close() then leaks. Every access
+of ``_pool``/``_stopped`` crosses threads; none holds a common lock.
+"""
+
+import threading
+
+
+class CompileService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool = None
+        self._stopped = False
+        self._feeder_thread = threading.Thread(target=self._feeder, daemon=True)
+        self._feeder_thread.start()
+
+    def _ensure_pool(self):
+        if self._pool is None:  # feeder thread: unguarded check...
+            self._pool = _spawn_pool()  # ...then unguarded respawn
+        return self._pool
+
+    def _feeder(self):
+        while not self._stopped:  # unguarded cross-thread flag read
+            pool = self._ensure_pool()
+            pool.feed()
+
+    def close(self):
+        self._stopped = True  # main thread: unguarded flag write
+        self._pool = None  # races the feeder's respawn -> leaked pool
+
+
+def _spawn_pool():
+    return object()

@@ -1,0 +1,48 @@
+"""G013 negatives: the three sanctioned stale-mesh disciplines.
+
+* rebuild the sharding from ``self.mesh`` AFTER the possible re-shard
+* generation-key mesh-derived caches with ``_aot_gen`` (stale keys miss)
+* have the re-shard path itself rebind the derived attribute
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class Engine:
+    def __init__(self, mesh, active):
+        self.mesh = mesh
+        self.active = list(active)
+        self._aot_gen = 0
+        self._view_specs = {}
+
+    def _reshard_world(self, active):
+        self.active = list(active)
+        self.mesh = _data_mesh(self.active)
+        self._aot_gen += 1
+        self._repl_sharding = NamedSharding(self.mesh, P())  # rebinds
+
+    def resume(self, ckpt):
+        state = _load_state(ckpt)
+        if ckpt.active != self.active:
+            self._reshard_world(ckpt.active)
+        sharding = NamedSharding(self.mesh, P("data"))  # post-reshard: fresh
+        return jax.device_put(state, sharding)
+
+    def _build_cache(self, key):
+        # generation-keyed: entries from an old mesh can never resolve
+        self._view_specs[key] = (self._aot_gen, NamedSharding(self.mesh, P()))
+
+    def _build_repl(self):
+        self._repl_sharding = NamedSharding(self.mesh, P())  # reshard rebinds
+
+    def place(self, x):
+        return jax.device_put(x, self._repl_sharding)
+
+
+def _data_mesh(active):
+    return object()
+
+
+def _load_state(ckpt):
+    return object()

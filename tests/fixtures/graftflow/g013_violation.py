@@ -1,0 +1,45 @@
+"""G013 seed: the pre-PR-6 restore-onto-old-mesh crash, minimized.
+
+Shape 1 (local): ``resume`` builds a NamedSharding from ``self.mesh``
+BEFORE the elastic path can call ``_reshard_world``, then places the
+restored state with the stale capture — replicated over the full ORIGINAL
+device set, mixed-device crash at the first combine.
+
+Shape 2 (class invariant): ``_build_cache`` stores a mesh-derived sharding
+in an attribute that no re-shard path ever rebinds.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class Engine:
+    def __init__(self, mesh, active):
+        self.mesh = mesh
+        self.active = list(active)
+
+    def _reshard_world(self, active):
+        self.active = list(active)
+        self.mesh = _data_mesh(self.active)
+
+    def resume(self, ckpt):
+        sharding = NamedSharding(self.mesh, P("data"))  # pre-reshard capture
+        state = _load_state(ckpt)
+        if ckpt.active != self.active:
+            self._reshard_world(ckpt.active)
+        return jax.device_put(state, sharding)  # STALE mesh placement
+
+    def _build_cache(self):
+        # mesh-derived attribute: _reshard_world never rebinds it
+        self._repl_sharding = NamedSharding(self.mesh, P())
+
+    def place(self, x):
+        return jax.device_put(x, self._repl_sharding)
+
+
+def _data_mesh(active):
+    return object()
+
+
+def _load_state(ckpt):
+    return object()

@@ -1,9 +1,13 @@
-"""Tier-1 gate: the shipped tree must lint clean.
+"""Tier-1 gate: the shipped tree must lint clean — single-file AND flow.
 
 Any future PR that reintroduces a G00x violation in the package or bench.py
 fails the default fast pytest run right here — the CI half of the ISSUE-1
 contract (`graftlint dynamic_load_balance_distributeddnn_tpu bench.py`
-exits 0).
+exits 0). Since ISSUE 8 the gate also runs the whole-program rules
+(`--flow`: G011 donation lifetimes, G012 thread/lock discipline, G013
+stale-mesh placement) with NO baseline file: every pre-existing finding was
+either fixed or carries an inline `# graftlint: disable=G01x` with a
+justification comment, so new interprocedural regressions fail here too.
 """
 
 import pathlib
@@ -11,14 +15,22 @@ import pathlib
 from dynamic_load_balance_distributeddnn_tpu.analysis.cli import main as cli_main
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
+TARGETS = [
+    str(REPO / "dynamic_load_balance_distributeddnn_tpu"),
+    str(REPO / "bench.py"),
+]
 
 
 def test_shipped_tree_lints_clean(capsys):
-    rc = cli_main(
-        [
-            str(REPO / "dynamic_load_balance_distributeddnn_tpu"),
-            str(REPO / "bench.py"),
-        ]
-    )
+    rc = cli_main(TARGETS)
     out = capsys.readouterr().out
     assert rc == 0, f"graftlint found violations in the shipped tree:\n{out}"
+
+
+def test_shipped_tree_flow_lints_clean(capsys):
+    rc = cli_main(["--flow", "--no-cache", *TARGETS])
+    out = capsys.readouterr().out
+    assert rc == 0, (
+        "graftlint --flow found unsanctioned whole-program violations in "
+        f"the shipped tree:\n{out}"
+    )

@@ -12,11 +12,13 @@ Usage::
     graftlint --list-rules
 
 ``--flow`` adds the whole-program rules (G011 donation lifetimes, G012
-thread/lock discipline, G013 stale-mesh placement) on top of the
-single-file ones; selecting a flow code implies it. ``--format json|sarif``
-emits machine-readable findings (SARIF for per-line CI annotation).
-Findings are cached by file content hash and the per-file work runs on a
-process pool (``--jobs``).
+thread/lock discipline, G013 stale-mesh placement, and the graftmesh
+families: G014 collective/axis consistency, G015 sharding-spec flow, G016
+non-uniform shard arithmetic) on top of the single-file ones; selecting a
+flow code implies it. ``--format json|sarif`` emits machine-readable
+findings (SARIF for per-line CI annotation — ``scripts/lint_sarif.sh`` is
+the wired CI invocation). Findings are cached by file content hash and the
+per-file work runs on a process pool (``--jobs``).
 
 Exit status: 0 when clean, 1 when findings, 2 on usage/parse errors.
 """
@@ -54,7 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
             "recorded walls (G008), registry bypass (G009), unguarded "
             "recovery blocking (G010); with --flow also the whole-program "
             "rules: donation lifetimes (G011), thread/lock discipline "
-            "(G012), stale-mesh placement (G013)."
+            "(G012), stale-mesh placement (G013), collective/axis "
+            "consistency (G014), sharding-spec flow (G015), non-uniform "
+            "shard arithmetic (G016)."
         ),
     )
     parser.add_argument(
@@ -77,7 +81,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--flow",
         action="store_true",
-        help="run the whole-program dataflow rules (G011-G013) too",
+        help="run the whole-program dataflow rules (G011-G016) too",
     )
     parser.add_argument(
         "--format",

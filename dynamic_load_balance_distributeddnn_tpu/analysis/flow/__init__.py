@@ -3,11 +3,16 @@
 Pipeline: every module is parsed once and lowered to a picklable
 :class:`~.ir.ModuleSummary` (content-hash cached, project.py), a
 :class:`~.callgraph.CallGraph` resolves calls and propagates interprocedural
-facts (donated params/attrs, return aliases, foreign-buffer returns, lock
-environments, thread reachability), and the flow rules (rules.py G011-G013)
-check donation lifetimes, thread/lock discipline, and stale-mesh placement
-over the whole package at once. ``graftlint --flow`` is the CLI entry;
-:func:`analyze_paths` the library one.
+facts (donated params/attrs — including through ``**kwargs`` forwarding and
+``tree_map`` lambdas — return aliases, foreign-buffer returns, lock
+environments, thread reachability), and the flow rules check donation
+lifetimes (G011), thread/lock discipline (G012), and stale-mesh placement
+(G013) over the whole package at once. mesh.py layers the graftmesh
+semantics on top — a :class:`~.mesh.MeshModel` of mesh constructions, axis
+names, and sharding-spec identities feeding G014 (collective/axis
+consistency), G015 (sharding-spec flow), and G016 (non-uniform shard
+arithmetic). ``graftlint --flow`` is the CLI entry; :func:`analyze_paths`
+the library one.
 """
 
 from __future__ import annotations
@@ -24,6 +29,9 @@ from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import (
     Project,
     summarize_file,
     summarize_source,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.mesh import (
+    MeshModel,
 )
 from dynamic_load_balance_distributeddnn_tpu.analysis.flow.rules import (
     FLOW_RULES,
@@ -51,6 +59,7 @@ __all__ = [
     "CallGraph",
     "FLOW_RULES",
     "FunctionSummary",
+    "MeshModel",
     "ModuleSummary",
     "Project",
     "analyze_paths",

@@ -272,6 +272,10 @@ class CallGraph:
 
         # facts, all keyed by fqn
         self.donated_params: Dict[str, Dict[int, int]] = {f: {} for f in fns}
+        # keyword-name donations: ``def outer(**kw): inner(**kw)`` where
+        # inner donates a param named k means outer donates keyword k — the
+        # **kwargs forwarding channel positional indices cannot express
+        self.donated_kwnames: Dict[str, Dict[str, int]] = {f: {} for f in fns}
         self.donated_attrs: Dict[str, Dict[str, int]] = {f: {} for f in fns}
         self.returns_param_alias: Dict[str, Set[int]] = {f: set() for f in fns}
         self.returns_attr_alias: Dict[str, Set[str]] = {f: set() for f in fns}
@@ -349,10 +353,11 @@ class CallGraph:
                 if e is None:
                     continue
                 callee_don = self.donated_params.get(e.callee)
-                if not callee_don:
+                callee_kw = self.donated_kwnames.get(e.callee) or {}
+                if not callee_don and not callee_kw:
                     continue
                 callee = self.project.functions[e.callee]
-                for pidx in callee_don:
+                for pidx in callee_don or ():
                     pos = pidx - e.param_offset
                     tok: Optional[str] = None
                     if 0 <= pos < len(call.args):
@@ -369,6 +374,11 @@ class CallGraph:
                                     tok = v
                     if tok:
                         yield stmt, call, tok, call.line
+                # keyword-name donations (incl. positional donations matched
+                # by name above): explicit kwargs at this site
+                for k, v in call.kwargs:
+                    if k in callee_kw and v:
+                        yield stmt, call, v, call.line
 
     def _flow_one(
         self, fqn: str, fn: FunctionSummary, donors: Dict[str, Tuple[int, ...]]
@@ -383,6 +393,35 @@ class CallGraph:
             if i not in self.donated_params[fqn]:
                 self.donated_params[fqn][i] = fn.line
                 changed = True
+
+        # **kwargs forwarding: ``def outer(**kw): inner(**kw)`` — every
+        # keyword inner donates (positionally-declared params included, by
+        # name) becomes a keyword donation of outer itself, so outer's
+        # CALLERS see their explicit ``state=...`` arguments die
+        if fn.kwarg_param:
+            for e in self.edges.get(fqn, ()):
+                forwards = any(
+                    k == "**" and v == fn.kwarg_param for k, v in e.call.kwargs
+                )
+                if not forwards:
+                    continue
+                callee = self.project.functions[e.callee]
+                donated_names = set(self.donated_kwnames.get(e.callee, ()))
+                for pidx in self.donated_params.get(e.callee, ()):
+                    if pidx < len(callee.params):
+                        donated_names.add(callee.params[pidx])
+                for name in donated_names:
+                    # a keyword the call already binds explicitly is not
+                    # forwarded from **kw; neither is one that lands in an
+                    # own named parameter of this function — the caller's
+                    # `state=...` binds THAT param, never reaching **kw
+                    if any(k == name for k, _ in e.call.kwargs):
+                        continue
+                    if name in fn.params:
+                        continue
+                    if name not in self.donated_kwnames[fqn]:
+                        self.donated_kwnames[fqn][name] = e.call.line
+                        changed = True
 
         for _stmt, _call, tok, line in self._donation_sites(fn, donors):
             origins = snaps[stmt_index[id(_stmt)]]

@@ -79,6 +79,205 @@ _PUT_TAILS = {"device_put", "device_put_sharded", "device_put_replicated"}
 # context must NOT propagate across these edges (callgraph.py).
 _SPAWN_CTOR_TAILS = {"Thread"}
 _SPAWN_SUBMIT_TAILS = {"submit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+# Mesh/sharding construction spellings (graftmesh raw material). The repo
+# funnels every mesh through parallel/mesh.py, so the helper tails are part
+# of the linter's knowledge table the same way KNOWN_DONOR_ATTRS is.
+_MESH_CTOR_TAILS = {"Mesh", "data_mesh"}
+_SHARDING_CTOR_TAILS = {
+    "NamedSharding",
+    "replicated_sharding",
+    "stacked_sharding",
+    "batch_sharding",
+}
+_PSPEC_TAILS = {"PartitionSpec", "P"}
+
+# tree_map spellings whose first argument is the mapped callable: a donor
+# called from inside the lambda donates the mapped TREES (args 1..n), so the
+# lowerer emits synthetic CallFacts with the lambda params substituted.
+_TREE_MAP_NAMES = {
+    "jax.tree_util.tree_map",
+    "jax.tree.map",
+    "tree_util.tree_map",
+    "tree_map",
+}
+
+
+@dataclass(frozen=True)
+class SpecCtor:
+    """One mesh/sharding/PartitionSpec construction, as lowered facts.
+
+    ``axes`` entries are: a literal axis string, ``None`` (replicated dim),
+    ``"$<token>"`` for a name/attr to resolve later (module constants, param
+    defaults — mesh.py's job), or ``"?"`` for an opaque expression. When the
+    ctor is a helper with a defaulted axis (``data_mesh(devices)``),
+    ``explicit_axes`` is False and axes stay empty for mesh.py to fill from
+    the helper's own parameter default."""
+
+    kind: str  # "mesh" | "sharding" | "pspec"
+    ctor: str  # constructing tail ("Mesh", "NamedSharding", "batch_sharding"…)
+    axes: Tuple[Optional[str], ...]
+    mesh_token: str  # dotted token of the mesh argument ("self.mesh"), or ""
+    dim: int  # batch_sharding axis_dim: literal value, 0 default, -1 opaque
+    size_idents: FrozenSet[str]  # identifiers sizing the mesh (devices arg)
+    line: int
+    explicit_axes: bool = True
+
+
+def _axis_entry(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return None
+        if isinstance(node.value, str):
+            return node.value
+        return "?"
+    tok = dotted_name(node)
+    return f"${tok}" if tok is not None else "?"
+
+
+def _axes_tuple(node: Optional[ast.expr]) -> Tuple[Optional[str], ...]:
+    """Axes from a ``("data",)`` / ``(axis,)`` / ``"data"`` expression."""
+    if node is None:
+        return ("?",)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(_axis_entry(e) for e in node.elts)
+    entry = _axis_entry(node)
+    return (entry,)
+
+
+def _call_kwarg(node: ast.Call, key: str) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == key:
+            return kw.value
+    return None
+
+
+def spec_ctor(node: ast.Call) -> Optional["SpecCtor"]:
+    """Recognize a mesh/sharding/spec construction and lower its facts."""
+    name = call_name(node)
+    tail = _attr_tail(name)
+    # the tail sets above are the dispatch gate; the branches below lower
+    # each ctor's specific argument shape
+    if tail not in _MESH_CTOR_TAILS | _SHARDING_CTOR_TAILS | _PSPEC_TAILS:
+        return None
+    line = node.lineno
+    if tail in _PSPEC_TAILS:
+        return SpecCtor(
+            kind="pspec",
+            ctor=tail,
+            axes=tuple(_axis_entry(a) for a in node.args),
+            mesh_token="",
+            dim=0,
+            size_idents=frozenset(),
+            line=line,
+        )
+    if tail == "Mesh":
+        axes_expr = node.args[1] if len(node.args) > 1 else (
+            _call_kwarg(node, "axis_names")
+        )
+        size = (
+            frozenset(identifiers_in(node.args[0])) if node.args else frozenset()
+        )
+        return SpecCtor(
+            kind="mesh",
+            ctor=tail,
+            axes=_axes_tuple(axes_expr),
+            mesh_token="",
+            dim=0,
+            size_idents=size,
+            line=line,
+        )
+    if tail == "data_mesh":
+        axis_expr = node.args[1] if len(node.args) > 1 else _call_kwarg(node, "axis")
+        size = (
+            frozenset(identifiers_in(node.args[0])) if node.args else frozenset()
+        )
+        if axis_expr is None:
+            return SpecCtor(
+                kind="mesh", ctor=tail, axes=(), mesh_token="", dim=0,
+                size_idents=size, line=line, explicit_axes=False,
+            )
+        return SpecCtor(
+            kind="mesh", ctor=tail, axes=(_axis_entry(axis_expr),),
+            mesh_token="", dim=0, size_idents=size, line=line,
+        )
+    if tail == "NamedSharding":
+        mesh_tok = dotted_name(node.args[0]) if node.args else None
+        spec_expr_node = node.args[1] if len(node.args) > 1 else (
+            _call_kwarg(node, "spec")
+        )
+        axes: Tuple[Optional[str], ...] = ("?",)
+        if isinstance(spec_expr_node, ast.Call) and _attr_tail(
+            call_name(spec_expr_node)
+        ) in _PSPEC_TAILS:
+            axes = tuple(_axis_entry(a) for a in spec_expr_node.args)
+        return SpecCtor(
+            kind="sharding", ctor=tail, axes=axes,
+            mesh_token=mesh_tok or "", dim=0, size_idents=frozenset(),
+            line=line,
+        )
+    if tail == "replicated_sharding":
+        mesh_tok = dotted_name(node.args[0]) if node.args else None
+        return SpecCtor(
+            kind="sharding", ctor=tail, axes=(),
+            mesh_token=mesh_tok or "", dim=0, size_idents=frozenset(),
+            line=line,
+        )
+    if tail == "stacked_sharding":
+        mesh_tok = dotted_name(node.args[0]) if node.args else None
+        axis_expr = node.args[1] if len(node.args) > 1 else _call_kwarg(node, "axis")
+        if axis_expr is None:
+            return SpecCtor(
+                kind="sharding", ctor=tail, axes=(), mesh_token=mesh_tok or "",
+                dim=0, size_idents=frozenset(), line=line, explicit_axes=False,
+            )
+        return SpecCtor(
+            kind="sharding", ctor=tail, axes=(_axis_entry(axis_expr),),
+            mesh_token=mesh_tok or "", dim=0, size_idents=frozenset(),
+            line=line,
+        )
+    if tail == "batch_sharding":
+        mesh_tok = dotted_name(node.args[0]) if node.args else None
+        axis_expr = node.args[2] if len(node.args) > 2 else _call_kwarg(node, "axis")
+        dim_expr = node.args[3] if len(node.args) > 3 else (
+            _call_kwarg(node, "axis_dim")
+        )
+        dim = 0
+        if dim_expr is not None:
+            try:
+                val = ast.literal_eval(dim_expr)
+                dim = int(val) if isinstance(val, int) else -1
+            except (ValueError, SyntaxError):
+                dim = -1
+        axes: Tuple[Optional[str], ...]
+        explicit = True
+        if axis_expr is None:
+            axes, explicit = (), False
+        else:
+            axes = (_axis_entry(axis_expr),)
+        return SpecCtor(
+            kind="sharding", ctor=tail, axes=axes,
+            mesh_token=mesh_tok or "", dim=dim, size_idents=frozenset(),
+            line=line, explicit_axes=explicit,
+        )
+    return None
+
+
+def _literal_value(node: ast.expr):
+    """Picklable literal of an expression, or None: strings/ints/bools and
+    flat tuples of those — the axis names and registry-key shapes the mesh
+    rules resolve."""
+    try:
+        val = ast.literal_eval(node)
+    except (ValueError, SyntaxError, TypeError):
+        return None
+    ok = (str, int, bool, float, type(None))
+    if isinstance(val, ok):
+        return val
+    if isinstance(val, (tuple, list)) and all(isinstance(v, ok) for v in val):
+        return tuple(val)
+    return None
 
 
 @dataclass(frozen=True)
@@ -96,6 +295,13 @@ class CallFact:
     locks: FrozenSet[str]  # self-lock tokens lexically held at the site
     donate_argnums: Tuple[int, ...] = ()  # non-empty on jit constructions
     in_loop: bool = False
+    # graftmesh facts: this call's own spec construction (when it IS one),
+    # inline spec constructions per argument, and literal argument values
+    spec: Optional["SpecCtor"] = None
+    spec_args: Tuple[Optional["SpecCtor"], ...] = ()
+    spec_kwargs: Tuple[Tuple[str, Optional["SpecCtor"]], ...] = ()
+    lit_args: Tuple[object, ...] = ()
+    lit_kwargs: Tuple[Tuple[str, object], ...] = ()
 
 
 @dataclass(frozen=True)
@@ -110,6 +316,7 @@ class BindFact:
     alias_sources: Tuple[str, ...]  # tokens the RHS value may alias
     rhs_is_copy: bool  # RHS is a forced-copy spelling (breaks aliases)
     donate_argnums: Tuple[int, ...] = ()  # RHS is jit(..., donate_argnums=...)
+    spec: Optional["SpecCtor"] = None  # RHS is a mesh/sharding construction
 
 
 @dataclass(frozen=True)
@@ -138,6 +345,7 @@ class RetFact:
     device_put_of: Tuple[str, ...]  # put args when return IS a device_put(...)
     device_put_copied: bool  # every put arg is copy-wrapped
     line: int
+    spec: Optional["SpecCtor"] = None  # return IS a spec construction
 
 
 @dataclass(frozen=True)
@@ -173,6 +381,10 @@ class FunctionSummary:
     decorator_donate_argnums: Tuple[int, ...] = ()  # @partial(jit, donate_...)
     lock_order_edges: Tuple[Tuple[str, str], ...] = ()  # (outer, inner) tokens
     is_setup: bool = False  # __init__/setup/build-style scope
+    kwarg_param: str = ""  # **kwargs name, "" when absent — the donation-
+    # forwarding channel: inner(**kw) hands EVERY forwarded keyword through
+    # param_defaults: per-param default, ("lit", value) | ("tok", dotted) | None
+    param_defaults: Tuple[Optional[Tuple[str, object]], ...] = ()
 
 
 @dataclass
@@ -193,6 +405,9 @@ class ModuleSummary:
     # callgraph's cross-module resolution gate: ``obj.m(...)`` may resolve
     # to class C's method only if this module actually names C somewhere
     mentioned: FrozenSet[str] = frozenset()
+    # module-level NAME = "literal" bindings (DATA_AXIS = "data"): the axis-
+    # name constant table graftmesh resolves `$token` spec entries against
+    str_constants: Dict[str, str] = field(default_factory=dict)
 
 
 _SETUP_NAMES = {"__init__", "__post_init__", "setup", "__init_subclass__"}
@@ -429,7 +644,104 @@ class _FunctionLowerer:
             locks=self._locks_at(node),
             donate_argnums=donate,
             in_loop=in_loop,
+            spec=spec_ctor(node),
+            spec_args=tuple(
+                spec_ctor(a) if isinstance(a, ast.Call) else None
+                for a in node.args
+            ),
+            spec_kwargs=tuple(
+                (
+                    kw.arg or "**",
+                    spec_ctor(kw.value)
+                    if isinstance(kw.value, ast.Call)
+                    else None,
+                )
+                for kw in node.keywords
+            ),
+            lit_args=tuple(_literal_value(a) for a in node.args),
+            lit_kwargs=tuple(
+                (kw.arg or "**", _literal_value(kw.value))
+                for kw in node.keywords
+            ),
         )
+
+    def _tree_map_synthetics(
+        self, node: ast.Call, in_loop: bool
+    ) -> List[CallFact]:
+        """``tree_map(lambda x, y: f(x, y), state, grads)`` lowers a synthetic
+        ``f(state, grads)`` call: the lambda body runs per-leaf over the mapped
+        trees, so a donor called inside it donates the TREE arguments — facts
+        the shallow walk (which never enters lambda scopes) would drop."""
+        if call_name(node) not in _TREE_MAP_NAMES:
+            return []
+        if not node.args or not isinstance(node.args[0], ast.Lambda):
+            return []
+        lam = node.args[0]
+        lam_params = [a.arg for a in lam.args.args]
+        tree_toks = [dotted_name(a) for a in node.args[1:]]
+        tree_idents = [frozenset(identifiers_in(a)) for a in node.args[1:]]
+        param_tok = {
+            p: tree_toks[i] for i, p in enumerate(lam_params) if i < len(tree_toks)
+        }
+        param_ids = {
+            p: tree_idents[i]
+            for i, p in enumerate(lam_params)
+            if i < len(tree_idents)
+        }
+        out: List[CallFact] = []
+        for inner in ast.walk(lam.body):
+            if not isinstance(inner, ast.Call):
+                continue
+            name = call_name(inner) or ""
+            if not name:
+                continue
+            mapped_args: List[Optional[str]] = []
+            mapped_idents: List[FrozenSet[str]] = []
+            for a in inner.args:
+                tok = dotted_name(a)
+                base = tok.split(".", 1)[0] if tok else None
+                if tok in param_tok:
+                    mapped_args.append(param_tok[tok])
+                elif base in param_tok and tok is not None:
+                    # x.foo aliases (a leaf of) the mapped tree — coarse
+                    mapped_args.append(param_tok[base])
+                else:
+                    mapped_args.append(tok)
+                ids = frozenset(identifiers_in(a))
+                for p in lam_params:
+                    if p in ids:
+                        ids = (ids - {p}) | param_ids.get(p, frozenset())
+                mapped_idents.append(ids)
+            out.append(
+                CallFact(
+                    name=name,
+                    tail=_attr_tail(name),
+                    line=inner.lineno,
+                    col=inner.col_offset,
+                    args=tuple(mapped_args),
+                    kwargs=(),
+                    arg_idents=tuple(mapped_idents),
+                    kwarg_idents=(),
+                    locks=self._locks_at(node),
+                    in_loop=in_loop,
+                )
+            )
+        return out
+
+    @staticmethod
+    def _target_token(expr: ast.expr) -> Optional[str]:
+        """Spawn-target token, looking through ``functools.partial(f, ...)``:
+        the partial's bound callable IS the function the thread runs."""
+        tok = dotted_name(expr)
+        if tok is not None:
+            return tok
+        if (
+            isinstance(expr, ast.Call)
+            and call_name(expr) in _PARTIAL_NAMES
+            and expr.args
+        ):
+            return dotted_name(expr.args[0])
+        return None
 
     def _spawns_in(self, calls: Sequence[ast.Call]) -> List[SpawnFact]:
         out: List[SpawnFact] = []
@@ -439,9 +751,9 @@ class _FunctionLowerer:
             if tail in _SPAWN_CTOR_TAILS:
                 for kw in node.keywords:
                     if kw.arg == "target":
-                        target = dotted_name(kw.value)
+                        target = self._target_token(kw.value)
             elif tail in _SPAWN_SUBMIT_TAILS and node.args:
-                target = dotted_name(node.args[0])
+                target = self._target_token(node.args[0])
             if target:
                 out.append(SpawnFact(target=target, line=node.lineno))
         return out
@@ -466,10 +778,12 @@ class _FunctionLowerer:
             )
         rhs_call_name = ""
         donate: Tuple[int, ...] = ()
+        spec: Optional[SpecCtor] = None
         if isinstance(value, ast.Call):
             rhs_call_name = call_name(value) or ""
             if is_jit_construction(value):
                 donate = literal_int_tuple(jit_kwarg(value, "donate_argnums")) or ()
+            spec = spec_ctor(value)
         return BindFact(
             targets=tuple(targets),
             line=stmt.lineno,
@@ -479,6 +793,7 @@ class _FunctionLowerer:
             alias_sources=tuple(_alias_sources(value)),
             rhs_is_copy=_is_copy_expr(value),
             donate_argnums=donate,
+            spec=spec,
         )
 
     def _ret_fact(self, stmt: ast.Return) -> RetFact:
@@ -499,6 +814,7 @@ class _FunctionLowerer:
             device_put_of=put_of,
             device_put_copied=put_copied,
             line=stmt.lineno,
+            spec=spec_ctor(v) if isinstance(v, ast.Call) else None,
         )
 
     def _attr_accesses(
@@ -604,6 +920,33 @@ class _FunctionLowerer:
 
     # -- main ---------------------------------------------------------------
 
+    @staticmethod
+    def _param_defaults(args: ast.arguments) -> Tuple[Optional[Tuple[str, object]], ...]:
+        """Per-param default facts aligned with the params tuple: a literal
+        (``axis_dim=0``), a name/attr token (``axis=DATA_AXIS`` — mesh.py
+        resolves it against module constants), or None."""
+        positional = args.posonlyargs + args.args
+        out: List[Optional[Tuple[str, object]]] = [None] * len(positional)
+        for a, d in zip(positional[len(positional) - len(args.defaults):], args.defaults):
+            idx = positional.index(a)
+            lit = _literal_value(d)
+            if lit is not None or (isinstance(d, ast.Constant) and d.value is None):
+                out[idx] = ("lit", lit)
+            else:
+                tok = dotted_name(d)
+                out[idx] = ("tok", tok) if tok is not None else None
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is None:
+                out.append(None)
+                continue
+            lit = _literal_value(d)
+            if lit is not None or (isinstance(d, ast.Constant) and d.value is None):
+                out.append(("lit", lit))
+            else:
+                tok = dotted_name(d)
+                out.append(("tok", tok) if tok is not None else None)
+        return tuple(out)
+
     def lower(self) -> FunctionSummary:
         fn = self.fn
         args = fn.args
@@ -629,7 +972,11 @@ class _FunctionLowerer:
                 isinstance(p, (ast.For, ast.AsyncFor, ast.While))
                 for p in self._ancestors(stmt)
             )
-            call_facts = tuple(self._call_fact(c, in_loop) for c in calls)
+            call_facts = tuple(self._call_fact(c, in_loop) for c in calls) + tuple(
+                sf
+                for c in calls
+                for sf in self._tree_map_synthetics(c, in_loop)
+            )
             ret = self._ret_fact(stmt) if isinstance(stmt, ast.Return) else None
             stmt_facts.append(
                 StmtFact(
@@ -656,6 +1003,8 @@ class _FunctionLowerer:
             decorator_donate_argnums=dec_donate,
             lock_order_edges=tuple(sorted(self.lock_edges)),
             is_setup=_is_setup_name(fn.name),
+            kwarg_param=args.kwarg.arg if args.kwarg else "",
+            param_defaults=self._param_defaults(args),
         )
 
     def _ancestors(self, node: ast.AST):
@@ -704,6 +1053,15 @@ def summarize_module(
             codes = suppressed_rules(text)
             if codes:
                 summary.suppressions[i] = frozenset(codes)
+
+    # module-level string constants (DATA_AXIS = "data"): graftmesh's axis-
+    # name resolution table
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+            if isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        summary.str_constants[t.id] = node.value.value
 
     # classes and their lock attributes
     for node in ast.walk(tree):

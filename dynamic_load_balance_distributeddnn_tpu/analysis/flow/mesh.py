@@ -1,0 +1,1276 @@
+"""graftmesh: whole-program SPMD sharding & collective semantics for graftflow.
+
+The next tentpoles (ZeRO-1 sharded updates, hierarchical multi-host
+collectives) multiply the places a ``PartitionSpec``, mesh axis name, or
+collective axis can silently disagree — and the repo's two worst shipped
+mesh bugs (PR 6's restore-onto-the-old-mesh placement and the fused
+lowering-spec vs dispatch-seed mismatch) were exactly this class. This
+module puts a mesh/sharding semantics layer on the graftflow engine:
+
+* :class:`MeshModel` — the whole-program mesh environment, built once per
+  run from the flow IR's construction facts (ir.py ``SpecCtor``):
+
+  - **axis universe**: every axis name any mesh construction in the program
+    defines, with ``$token`` entries resolved through module string
+    constants (``DATA_AXIS = "data"``) and helper parameter defaults
+    (``data_mesh(devices, axis=DATA_AXIS)``).
+  - **mesh values**: axes of class mesh attributes (``self.mesh``), local
+    mesh bindings, mesh-returning helpers, and mesh-typed *parameters* —
+    the latter joined over resolved call sites as a fixpoint lattice (a
+    param's axes are the union of every mesh its callers hand in).
+  - **required axes**: per function, the concrete axis names its
+    collectives (``psum``/``all_gather``/``ppermute``/…) consume, closed
+    bottom-up over the call graph — the demand side the shard_map check
+    matches against the mesh value's supply side.
+  - **spec identities**: normalized sharding values (``("sharding",
+    ("data",))``, ``("batch", "data", 1)``) flowing through binds, helper
+    calls (``replicated_sharding``/``batch_sharding``), and returns.
+
+* the rule families G014-G016 (registered in flow/rules.py):
+
+  - **G014 collective/axis consistency** — axis names that no reachable
+    mesh defines, shard_map'd functions whose required axes the mesh
+    argument cannot supply, and elastic classes sizing mesh-shaped values
+    from ``cfg.world_size`` when the re-shard rebuild makes ``world_size``
+    runtime state.
+  - **G015 sharding-spec flow** — a spec captured THROUGH a function
+    boundary before a reshard-reachable call then used to place (the
+    interprocedural twin of G013's local staleness), and dispatch
+    placements whose spec identity the class's AOT lowerings never
+    registered (the fused-lowering vs dispatch-seed incident). Both honor
+    the ``_aot_gen`` generation-key sanction G013 uses.
+  - **G016 non-uniform shard arithmetic** — DBS plans produce unequal
+    per-worker shards; values derived from the plan/share vectors must pass
+    the pad/quantize discipline (``quantize_batches``/``snap_to_bucket``/
+    ``_cap_*``) before reaching fixed-shape collectives or on-device
+    concatenations. Interprocedural: taint crosses call/return edges, so a
+    helper that feeds its parameter into ``all_gather`` flags the caller
+    passing a raw share-derived value.
+
+Everything runs on summaries only (no ASTs), so the pass stays cacheable
+and inside graftflow's runtime budget.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.callgraph import CallGraph
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.ir import (
+    CallFact,
+    FunctionSummary,
+    SpecCtor,
+    StmtFact,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import Project
+
+
+def _finding(code, path, line, col, message, fix_hint, symbol=""):
+    from dynamic_load_balance_distributeddnn_tpu.analysis.linter import Finding
+
+    return Finding(
+        code=code,
+        path=path,
+        line=line,
+        col=col,
+        message=message,
+        fix_hint=fix_hint,
+        symbol=symbol,
+    )
+
+
+# Collective spellings and where their axis-name argument sits.
+COLLECTIVE_AXIS_ARGS: Dict[str, int] = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "psum_scatter": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "reduce_scatter": 1,
+    "all_reduce": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+_AXIS_KWARGS = ("axis_name",)
+
+PLACEMENT_SPEC_ARG: Dict[str, int] = {
+    "device_put": 1,
+    "make_array_from_process_local_data": 0,
+}
+
+MESH_ATTRS = {"mesh", "_mesh"}
+GEN_MARKERS = {"_aot_gen", "aot_gen", "generation"}
+RESHARD_MARKERS = ("reshard", "_reshard")
+_REGISTER_TAILS = {"submit", "compile_now"}
+
+# mesh-construction helper whose axis parameter name the resolver chases
+_MESH_HELPER_AXIS_PARAM = {
+    "data_mesh": "axis",
+    "stacked_sharding": "axis",
+    "batch_sharding": "axis",
+}
+
+# DBS plan-builder surface whose outputs are UNEQUAL per-worker shard sizes
+# (and anything derived from them), until the pad/quantize discipline
+# re-shapes them onto the ladder.
+UNEQUAL_SOURCE_TAILS = {
+    "integer_batch_split",
+    "rebalance",
+    "rebalance_py",
+    "predict_batches",
+    "partition_indices",
+    "build_epoch_plan",
+    "initial_partition",
+}
+UNEQUAL_SOURCE_IDENTS = {"batch_sizes", "shares"}
+FIXED_SHAPE_COLLECTIVES = {
+    "all_gather",
+    "psum_scatter",
+    "all_to_all",
+    "ppermute",
+    "reduce_scatter",
+    "all_reduce",
+}
+_DEVICE_CONCAT_TAILS = {"concatenate", "stack", "hstack", "vstack"}
+_DEVICE_NS = ("jnp.", "jax.numpy.")
+_LOCAL_ORIGIN = "<plan>"
+
+
+def reshard_surface(
+    project: Project, graph: CallGraph
+) -> Tuple[Set[str], Set[str]]:
+    """(mesh mutators, functions from which a mutator is reachable).
+
+    A mutator is a non-setup method that rebinds a mesh attribute — the
+    elastic ``_reshard_world`` shape. Shared by G013 and the graftmesh
+    rules so "a re-shard can happen under this call" means one thing."""
+    mutators: Set[str] = set()
+    for fqn, fn in project.functions.items():
+        if fn.is_setup or not fn.cls:
+            continue
+        for stmt in fn.stmts:
+            if any(
+                acc.write and acc.attr in MESH_ATTRS
+                for acc in stmt.attr_accesses
+            ):
+                mutators.add(fqn)
+                break
+    can_reshard: Set[str] = set(mutators)
+    frontier = list(mutators)
+    while frontier:
+        cur = frontier.pop()
+        for e in graph.callers.get(cur, ()):
+            if e.caller not in can_reshard:
+                can_reshard.add(e.caller)
+                frontier.append(e.caller)
+    return mutators, can_reshard
+
+
+SpecId = Tuple  # ("sharding", axes) | ("batch", axis, dim) — normalized
+
+
+class MeshModel:
+    """Whole-program mesh environment over a Project + CallGraph."""
+
+    def __init__(
+        self,
+        project: Project,
+        graph: CallGraph,
+        reshard: Optional[Tuple[Set[str], Set[str]]] = None,
+    ):
+        self.project = project
+        self.graph = graph
+        self.functions = project.functions
+        self._edge_line_cache: Dict[str, Dict[Tuple[str, int], object]] = {}
+        self._build_constants()
+        self._build_helper_defaults()
+        self._build_mesh_facts()
+        self._build_required_axes()
+        self._build_spec_returns()
+        if reshard is None:
+            reshard = reshard_surface(project, graph)
+        self.mutators, self.can_reshard = reshard
+
+    # ------------------------------------------------------------ resolution
+
+    def edges_by_line(self, fqn: str) -> Dict[Tuple[str, int], object]:
+        """(call tail, line) -> Edge for one function, built once per fqn —
+        several resolvers key call-derived binds this way, some inside
+        fixpoint loops."""
+        cached = self._edge_line_cache.get(fqn)
+        if cached is None:
+            cached = {
+                (e.call.tail, e.call.line): e
+                for e in self.graph.edges.get(fqn, ())
+            }
+            self._edge_line_cache[fqn] = cached
+        return cached
+
+    def _build_constants(self) -> None:
+        """NAME -> string table. A name bound to CONFLICTING strings across
+        modules resolves to nothing (errs quiet, like the call graph)."""
+        seen: Dict[str, Set[str]] = {}
+        for mod in self.project.modules.values():
+            for name, val in mod.str_constants.items():
+                seen.setdefault(name, set()).add(val)
+        self.constants: Dict[str, str] = {
+            name: next(iter(vals)) for name, vals in seen.items() if len(vals) == 1
+        }
+
+    def _param_default_str(
+        self, fn: FunctionSummary, pname: str
+    ) -> Optional[str]:
+        try:
+            idx = fn.params.index(pname)
+        except ValueError:
+            return None
+        if idx >= len(fn.param_defaults):
+            return None
+        d = fn.param_defaults[idx]
+        if d is None:
+            return None
+        kind, val = d
+        if kind == "lit" and isinstance(val, str):
+            return val
+        if kind == "tok" and isinstance(val, str):
+            return self.constants.get(val.rsplit(".", 1)[-1])
+        return None
+
+    def _build_helper_defaults(self) -> None:
+        """Default axis string per mesh/sharding helper (``data_mesh`` et
+        al.), read from the helper's own summary so the knowledge lives in
+        parallel/mesh.py, not here."""
+        self.helper_axis_default: Dict[str, Optional[str]] = {}
+        for ctor, pname in _MESH_HELPER_AXIS_PARAM.items():
+            default: Optional[str] = None
+            cands = self.project.by_name.get(ctor, [])
+            if len(cands) == 1:
+                default = self._param_default_str(cands[0], pname)
+            self.helper_axis_default[ctor] = default
+
+    def resolve_axis_entry(
+        self, entry: Optional[str], fn: Optional[FunctionSummary]
+    ) -> Optional[str]:
+        """One axes entry -> concrete axis string, None (replicated dim),
+        or None-with-unknown (callers distinguish via :func:`entry_known`)."""
+        if entry is None:
+            return None
+        if entry == "?":
+            return None
+        if not entry.startswith("$"):
+            return entry
+        tok = entry[1:]
+        tail = tok.rsplit(".", 1)[-1]
+        if fn is not None and "." not in tok and tok in fn.params:
+            return self._param_default_str(fn, tok)
+        return self.constants.get(tail)
+
+    def entry_known(
+        self, entry: Optional[str], fn: Optional[FunctionSummary]
+    ) -> bool:
+        """True when the entry resolves (incl. an explicit ``None`` dim)."""
+        if entry is None:
+            return True
+        if entry == "?":
+            return False
+        if not entry.startswith("$"):
+            return True
+        return self.resolve_axis_entry(entry, fn) is not None
+
+    def spec_axes(
+        self, spec: SpecCtor, fn: Optional[FunctionSummary]
+    ) -> Optional[Tuple[Optional[str], ...]]:
+        """Fully-resolved axes tuple of a ctor, or None if any entry is
+        opaque. Helper defaults fill unexplicit axes."""
+        if not spec.explicit_axes:
+            default = self.helper_axis_default.get(spec.ctor)
+            if default is None:
+                return None
+            return (default,)
+        out: List[Optional[str]] = []
+        for e in spec.axes:
+            if not self.entry_known(e, fn):
+                return None
+            out.append(self.resolve_axis_entry(e, fn))
+        return tuple(out)
+
+    def spec_id(
+        self, spec: Optional[SpecCtor], fn: Optional[FunctionSummary]
+    ) -> Optional[SpecId]:
+        """Normalized identity of a SHARDING ctor (mesh/pspec return None:
+        they are not placement specs)."""
+        if spec is None or spec.kind != "sharding":
+            return None
+        axes = self.spec_axes(spec, fn)
+        if axes is None:
+            return None
+        if spec.ctor == "batch_sharding":
+            if spec.dim < 0:
+                return None
+            axis = axes[0] if axes else None
+            return ("batch", axis, spec.dim)
+        return ("sharding", tuple(a for a in axes))
+
+    # ------------------------------------------------------- mesh value env
+
+    def _build_mesh_facts(self) -> None:
+        # axis universe + per-class mesh axes + elastic classes. A mesh
+        # construction whose axes cannot be resolved (dynamic names) marks
+        # the universe INCOMPLETE: membership checks must then stay quiet —
+        # the dropped mesh may define any axis (the errs-quiet contract)
+        self.axis_universe: Set[str] = set()
+        self.axis_universe_complete = True
+        self.class_mesh_axes: Dict[Tuple[str, str], Set[str]] = {}
+        for fqn, fn in self.functions.items():
+            for stmt in fn.stmts:
+                for spec in self._stmt_specs(stmt):
+                    if spec.kind != "mesh":
+                        continue
+                    axes = self.spec_axes(spec, fn)
+                    if axes is None:
+                        self.axis_universe_complete = False
+                        continue
+                    concrete = {a for a in axes if a}
+                    self.axis_universe |= concrete
+                    bind = stmt.bind
+                    if (
+                        bind is not None
+                        and bind.spec is spec
+                        and fn.cls
+                        and any(
+                            t.startswith("self.")
+                            and t.split(".", 1)[1] in MESH_ATTRS
+                            for t in bind.targets
+                        )
+                    ):
+                        self.class_mesh_axes.setdefault(
+                            (fn.module, fn.cls), set()
+                        ).update(concrete)
+        # mesh-returning functions (data_mesh itself, wrappers)
+        self.mesh_returns: Dict[str, FrozenSet[str]] = {}
+        for _ in range(4):
+            changed = False
+            for fqn, fn in self.functions.items():
+                if fqn in self.mesh_returns:
+                    continue
+                axes = self._local_mesh_return(fn)
+                if axes is not None:
+                    self.mesh_returns[fqn] = axes
+                    changed = True
+            if not changed:
+                break
+        # mesh-typed params: union over resolved call sites (the lattice
+        # join — a param's axes are every mesh a caller may pass)
+        self.param_mesh_axes: Dict[Tuple[str, str], Set[str]] = {}
+        for _ in range(6):
+            changed = False
+            for fqn, fn in self.functions.items():
+                for e in self.graph.edges.get(fqn, ()):
+                    callee = self.functions.get(e.callee)
+                    if callee is None:
+                        continue
+                    for pos, tok in enumerate(e.call.args):
+                        if tok is None:
+                            continue
+                        pidx = pos + e.param_offset
+                        if pidx >= len(callee.params):
+                            continue
+                        axes = self.mesh_axes_of_token(fn, tok, e.call.line)
+                        if not axes:
+                            continue
+                        key = (e.callee, callee.params[pidx])
+                        cur = self.param_mesh_axes.setdefault(key, set())
+                        if not axes <= cur:
+                            cur |= axes
+                            changed = True
+                    for k, tok in e.call.kwargs:
+                        if tok is None or k == "**":
+                            continue
+                        axes = self.mesh_axes_of_token(fn, tok, e.call.line)
+                        if not axes:
+                            continue
+                        key = (e.callee, k)
+                        cur = self.param_mesh_axes.setdefault(key, set())
+                        if not axes <= cur:
+                            cur |= axes
+                            changed = True
+            if not changed:
+                break
+
+    @staticmethod
+    def _stmt_specs(stmt: StmtFact) -> Iterator[SpecCtor]:
+        if stmt.bind is not None and stmt.bind.spec is not None:
+            yield stmt.bind.spec
+        if stmt.ret is not None and stmt.ret.spec is not None:
+            yield stmt.ret.spec
+        for call in stmt.calls:
+            if call.spec is not None:
+                yield call.spec
+            for s in call.spec_args:
+                if s is not None:
+                    yield s
+            for _k, s in call.spec_kwargs:
+                if s is not None:
+                    yield s
+
+    def _local_mesh_return(self, fn: FunctionSummary) -> Optional[FrozenSet[str]]:
+        edge_by_line = self.edges_by_line(Project.fqn(fn))
+        local: Dict[str, FrozenSet[str]] = {}
+        for stmt in fn.stmts:
+            bind = stmt.bind
+            if bind is not None:
+                if bind.spec is not None and bind.spec.kind == "mesh":
+                    axes = self.spec_axes(bind.spec, fn)
+                    if axes is not None:
+                        for t in bind.targets:
+                            local[t] = frozenset(a for a in axes if a)
+                elif bind.rhs_call_tail:
+                    # m = make_mesh(...): chase the wrapper chain — this is
+                    # what lets the fixpoint grow past direct constructions
+                    e = edge_by_line.get((bind.rhs_call_tail, bind.line))
+                    if e is not None and e.callee in self.mesh_returns:
+                        for t in bind.targets:
+                            local[t] = self.mesh_returns[e.callee]
+            if stmt.ret is not None:
+                if stmt.ret.spec is not None and stmt.ret.spec.kind == "mesh":
+                    axes = self.spec_axes(stmt.ret.spec, fn)
+                    if axes is not None:
+                        return frozenset(a for a in axes if a)
+                for tok in stmt.ret.alias_tokens:
+                    if tok in local:
+                        return local[tok]
+        return None
+
+    def mesh_axes_of_token(
+        self, fn: FunctionSummary, token: str, at_line: Optional[int] = None
+    ) -> Set[str]:
+        """Axes of the mesh value ``token`` names inside ``fn`` (empty set =
+        unknown / not a mesh). ``at_line`` bounds the local-bind scan to
+        bindings BEFORE the use site — a later rebind must not win."""
+        if token.startswith("self.") and fn.cls:
+            attr = token.split(".", 1)[1]
+            if attr in MESH_ATTRS:
+                return set(
+                    self.class_mesh_axes.get((fn.module, fn.cls), set())
+                )
+            return set()
+        if "." not in token and token in fn.params:
+            return set(
+                self.param_mesh_axes.get((Project.fqn(fn), token), set())
+            )
+        # local binding: a mesh ctor, or a call into a mesh-returning helper
+        edge_by_line = self.edges_by_line(Project.fqn(fn))
+        axes: Set[str] = set()
+        for stmt in fn.stmts:
+            if at_line is not None and stmt.line >= at_line:
+                break
+            bind = stmt.bind
+            if bind is None or token not in bind.targets:
+                continue
+            if bind.spec is not None and bind.spec.kind == "mesh":
+                got = self.spec_axes(bind.spec, fn)
+                axes = set(a for a in got if a) if got is not None else set()
+            elif bind.rhs_call_tail:
+                e = edge_by_line.get((bind.rhs_call_tail, bind.line))
+                if e is not None and e.callee in self.mesh_returns:
+                    axes = set(self.mesh_returns[e.callee])
+                else:
+                    axes = set()
+            else:
+                # mesh = self.mesh-style rebind
+                srcs = [
+                    s for s in bind.alias_sources if s.startswith("self.")
+                    and s.split(".", 1)[1] in MESH_ATTRS
+                ]
+                if srcs and fn.cls:
+                    axes = set(
+                        self.class_mesh_axes.get((fn.module, fn.cls), set())
+                    )
+                else:
+                    axes = set()
+        return axes
+
+    # ---------------------------------------------------- required axes env
+
+    def _build_required_axes(self) -> None:
+        """Concrete axis names each function's collectives consume, closed
+        over the call graph (bottom-up union — the demand a shard_map's
+        mesh must satisfy). Axis tokens resolve through module constants
+        and the function's own parameter defaults."""
+        self.required_axes: Dict[str, Set[str]] = {}
+        self.axis_sites: Dict[str, List[Tuple[str, int, int, str]]] = {}
+        for fqn, fn in self.functions.items():
+            req: Set[str] = set()
+            sites: List[Tuple[str, int, int, str]] = []
+            for stmt in fn.stmts:
+                for call in stmt.calls:
+                    axis = self._call_axis(call, fn)
+                    if axis is not None:
+                        req.add(axis)
+                        sites.append((axis, call.line, call.col, call.tail))
+            self.required_axes[fqn] = req
+            self.axis_sites[fqn] = sites
+        for _ in range(6):
+            changed = False
+            for fqn in self.functions:
+                for e in self.graph.edges.get(fqn, ()):
+                    callee_req = self.required_axes.get(e.callee, set())
+                    if not callee_req <= self.required_axes[fqn]:
+                        self.required_axes[fqn] |= callee_req
+                        changed = True
+            if not changed:
+                break
+
+    def _call_axis(
+        self, call: CallFact, fn: FunctionSummary
+    ) -> Optional[str]:
+        idx = COLLECTIVE_AXIS_ARGS.get(call.tail)
+        if idx is None:
+            return None
+        entry: Optional[str] = None
+        if idx < len(call.lit_args) and isinstance(call.lit_args[idx], str):
+            entry = call.lit_args[idx]
+        elif idx < len(call.args) and call.args[idx]:
+            entry = f"${call.args[idx]}"
+        else:
+            for k, v in call.lit_kwargs:
+                if k in _AXIS_KWARGS and isinstance(v, str):
+                    entry = v
+            if entry is None:
+                for k, v in call.kwargs:
+                    if k in _AXIS_KWARGS and v:
+                        entry = f"${v}"
+        if entry is None:
+            return None
+        return self.resolve_axis_entry(entry, fn)
+
+    # ------------------------------------------------------- spec value env
+
+    def _build_spec_returns(self) -> None:
+        """fqn -> (SpecId, mesh_derived) for spec-returning helpers: the
+        channel G015 tracks specs across function boundaries with."""
+        self.spec_returns: Dict[str, Tuple[Optional[SpecId], bool]] = {}
+        for _ in range(4):
+            changed = False
+            for fqn, fn in self.functions.items():
+                if fqn in self.spec_returns:
+                    continue
+                got = self._local_spec_return(fn)
+                if got is not None:
+                    self.spec_returns[fqn] = got
+                    changed = True
+            if not changed:
+                break
+
+    def _local_spec_return(
+        self, fn: FunctionSummary
+    ) -> Optional[Tuple[Optional[SpecId], bool]]:
+        edge_by_line = self.edges_by_line(Project.fqn(fn))
+        local: Dict[str, Tuple[Optional[SpecId], bool]] = {}
+        for stmt in fn.stmts:
+            bind = stmt.bind
+            if bind is not None and bind.targets:
+                if bind.spec is not None and bind.spec.kind == "sharding":
+                    info = (
+                        self.spec_id(bind.spec, fn),
+                        bool(bind.spec.mesh_token),
+                    )
+                    for t in bind.targets:
+                        local[t] = info
+                elif bind.rhs_call_tail:
+                    e = edge_by_line.get((bind.rhs_call_tail, bind.line))
+                    if e is not None and e.callee in self.spec_returns:
+                        for t in bind.targets:
+                            local[t] = self.spec_returns[e.callee]
+            if stmt.ret is not None:
+                if stmt.ret.spec is not None and stmt.ret.spec.kind == "sharding":
+                    return (
+                        self.spec_id(stmt.ret.spec, fn),
+                        bool(stmt.ret.spec.mesh_token),
+                    )
+                for tok in stmt.ret.alias_tokens:
+                    if tok in local:
+                        return local[tok]
+        return None
+
+
+def _get_model(ctx) -> MeshModel:
+    model = getattr(ctx, "_mesh_model", None)
+    if model is None:
+        # share one reshard_surface computation per run with RuleG013
+        pair = getattr(ctx, "_reshard_surface", None)
+        model = MeshModel(ctx.project, ctx.graph, reshard=pair)
+        ctx._reshard_surface = (model.mutators, model.can_reshard)
+        ctx._mesh_model = model
+    return model
+
+
+def _stmt_idents(stmt: StmtFact) -> Set[str]:
+    out: Set[str] = set()
+    for tok, _l, _c in stmt.reads:
+        out.update(tok.split("."))
+    if stmt.bind is not None:
+        out |= set(stmt.bind.rhs_idents)
+    for call in stmt.calls:
+        for ids in call.arg_idents:
+            out |= ids
+        for _k, ids in call.kwarg_idents:
+            out |= ids
+    return out
+
+
+# --------------------------------------------------------------------------
+# G014 — collective/axis consistency
+
+
+class RuleG014:
+    code = "G014"
+    summary = (
+        "collective/shard_map axis name no reachable mesh defines, or an "
+        "axis-size assumption the elastic mesh rebuild invalidates"
+    )
+    fix_hint = (
+        "name collective axes after a mesh axis that actually exists at the "
+        "call site (the package defines them in parallel/mesh.py), give "
+        "shard_map a mesh carrying every axis the mapped function's "
+        "collectives use, and size mesh-shaped values from the engine's "
+        "RUNTIME world_size — after _reshard_world the mesh is rebuilt from "
+        "the surviving fleet, so cfg.world_size no longer matches the axis"
+    )
+
+    _SIZE_SINK_TAILS = (
+        set(PLACEMENT_SPEC_ARG)
+        | FIXED_SHAPE_COLLECTIVES
+        | {"device_put_sharded", "device_put_replicated"}
+        | set(_MESH_HELPER_AXIS_PARAM)
+        | {"NamedSharding", "replicated_sharding", "Mesh", "data_mesh"}
+    )
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        model = _get_model(ctx)
+        yield from self._check_axis_universe(ctx, model)
+        yield from self._check_shard_map(ctx, model)
+        yield from self._check_elastic_sizes(ctx, model)
+
+    # -- (a) axis names no mesh defines -------------------------------------
+
+    def _check_axis_universe(self, ctx, model: MeshModel) -> Iterator["Finding"]:
+        if not model.axis_universe or not model.axis_universe_complete:
+            # no meshes visible, or one with dynamic axes was dropped —
+            # membership against a partial universe would guess
+            return
+        seen: Set[Tuple[str, int, str]] = set()  # (path, line, axis) dedup
+        for fqn, fn in ctx.project.functions.items():
+            path = ctx.path_of(fn)
+            for axis, line, col, tail in model.axis_sites.get(fqn, ()):
+                if axis in model.axis_universe:
+                    continue
+                if (path, line, axis) in seen:
+                    continue
+                seen.add((path, line, axis))
+                if ctx.suppressed(fn, self.code, line):
+                    continue
+                yield _finding(
+                    self.code,
+                    path,
+                    line,
+                    col,
+                    f"`{tail}` names axis '{axis}' but no mesh construction "
+                    f"in the program defines it (known axes: "
+                    f"{sorted(model.axis_universe)}) — the collective will "
+                    "fail to resolve at trace time, or silently bind to the "
+                    "wrong axis after a mesh refactor",
+                    self.fix_hint,
+                    symbol=f"{fn.module}::{fn.qualname}",
+                )
+            # spec constructions naming unknown axes (P("dat") typos).
+            # ONE finding per (line, axis): the same construction surfaces
+            # through bind.spec, its own CallFact, the nested P call, and
+            # spec_args — without dedup a single typo reports 4x
+            for stmt in fn.stmts:
+                for spec in MeshModel._stmt_specs(stmt):
+                    if spec.kind == "mesh":
+                        continue
+                    axes = model.spec_axes(spec, fn)
+                    if axes is None:
+                        continue
+                    for a in axes:
+                        if a and a not in model.axis_universe:
+                            if (path, spec.line, a) in seen:
+                                break
+                            seen.add((path, spec.line, a))
+                            if ctx.suppressed(fn, self.code, spec.line):
+                                break
+                            yield _finding(
+                                self.code,
+                                ctx.path_of(fn),
+                                spec.line,
+                                0,
+                                f"`{spec.ctor}` spec names axis '{a}' but "
+                                "no mesh construction in the program "
+                                f"defines it (known axes: "
+                                f"{sorted(model.axis_universe)})",
+                                self.fix_hint,
+                                symbol=f"{fn.module}::{fn.qualname}",
+                            )
+                            break
+
+    # -- (b) shard_map supply vs demand -------------------------------------
+
+    def _check_shard_map(self, ctx, model: MeshModel) -> Iterator["Finding"]:
+        graph = ctx.graph
+        for fqn, fn in ctx.project.functions.items():
+            for stmt in fn.stmts:
+                sm = next(
+                    (c for c in stmt.calls if c.tail == "shard_map"), None
+                )
+                if sm is None:
+                    continue
+                mesh_tok: Optional[str] = None
+                for k, v in sm.kwargs:
+                    if k == "mesh" and v:
+                        mesh_tok = v
+                if mesh_tok is None and len(sm.args) > 1:
+                    mesh_tok = sm.args[1]
+                if not mesh_tok:
+                    continue
+                mesh_axes = model.mesh_axes_of_token(fn, mesh_tok, sm.line)
+                if not mesh_axes:
+                    continue  # unresolved mesh: stay quiet
+                target_tok = sm.args[0] if sm.args else None
+                if target_tok is None:
+                    # functools.partial(fn, ...)-wrapped target: the partial
+                    # is its own CallFact in this statement
+                    for c in stmt.calls:
+                        if c.tail == "partial" and c.args and c.args[0]:
+                            target_tok = c.args[0]
+                            break
+                if not target_tok:
+                    continue
+                target = graph._resolve_target(target_tok, fn)
+                if target is None:
+                    continue
+                req = model.required_axes.get(Project.fqn(target), set())
+                missing = sorted(req - mesh_axes)
+                if missing and not ctx.suppressed(fn, self.code, sm.line):
+                    yield _finding(
+                        self.code,
+                        ctx.path_of(fn),
+                        sm.line,
+                        sm.col,
+                        f"shard_map maps `{target_tok}` over mesh "
+                        f"`{mesh_tok}` (axes {sorted(mesh_axes)}) but the "
+                        f"mapped function's collectives require axes "
+                        f"{missing} the mesh does not carry",
+                        self.fix_hint,
+                        symbol=f"{fn.module}::{fn.qualname}",
+                    )
+                # inline P specs in the same statement must fit the mesh too
+                for c in stmt.calls:
+                    spec = c.spec
+                    if spec is None or spec.kind != "pspec":
+                        continue
+                    axes = model.spec_axes(spec, fn)
+                    if axes is None:
+                        continue
+                    bad = sorted(
+                        {a for a in axes if a and a not in mesh_axes}
+                    )
+                    if bad and not ctx.suppressed(fn, self.code, c.line):
+                        yield _finding(
+                            self.code,
+                            ctx.path_of(fn),
+                            c.line,
+                            c.col,
+                            f"shard_map in/out spec names axes {bad} the "
+                            f"mesh `{mesh_tok}` (axes {sorted(mesh_axes)}) "
+                            "does not carry",
+                            self.fix_hint,
+                            symbol=f"{fn.module}::{fn.qualname}",
+                        )
+
+    # -- (c) cfg.world_size sized mesh values in elastic classes ------------
+
+    def _check_elastic_sizes(self, ctx, model: MeshModel) -> Iterator["Finding"]:
+        elastic_classes = {
+            (fn.module, fn.cls)
+            for fqn, fn in ctx.project.functions.items()
+            if fqn in model.mutators
+        }
+        if not elastic_classes:
+            return
+        for fqn, fn in ctx.project.functions.items():
+            if (fn.module, fn.cls) not in elastic_classes or fn.is_setup:
+                continue
+            if fqn in model.mutators:
+                continue  # the rebuild itself reads cfg to derive topology
+            # locals whose value is SIZED by cfg.world_size (local flow:
+            # the vector is usually built one statement before it is placed)
+            cfg_sized: Set[str] = set()
+            for stmt in fn.stmts:
+                stmt_reads_cfg = any(
+                    tok == "cfg.world_size" or tok.endswith(".cfg.world_size")
+                    for tok, _l, _c in stmt.reads
+                )
+                bind = stmt.bind
+                if bind is not None:
+                    for tgt in bind.targets:
+                        if "." in tgt:
+                            continue
+                        if stmt_reads_cfg and "world_size" in bind.rhs_idents:
+                            cfg_sized.add(tgt)
+                        elif bind.rhs_idents & cfg_sized:
+                            cfg_sized.add(tgt)
+                        else:
+                            cfg_sized.discard(tgt)
+                # the sink's own ARGUMENTS must carry the cfg-sized value —
+                # a statement that merely reads cfg.world_size elsewhere
+                # (e.g. gating the placement on world size) is not a sizing
+                sink = next(
+                    (
+                        c
+                        for c in stmt.calls
+                        if c.tail in self._SIZE_SINK_TAILS
+                        and any(
+                            ids & cfg_sized or {"cfg", "world_size"} <= ids
+                            for ids in c.arg_idents
+                        )
+                    ),
+                    None,
+                )
+                if sink is None:
+                    continue
+                if ctx.suppressed(fn, self.code, sink.line):
+                    continue
+                carrier = next(
+                    (
+                        sorted(ids & cfg_sized)[0]
+                        for ids in sink.arg_idents
+                        if ids & cfg_sized
+                    ),
+                    "cfg.world_size",
+                )
+                yield _finding(
+                    self.code,
+                    ctx.path_of(fn),
+                    sink.line,
+                    sink.col,
+                    f"`{carrier}` is sized by cfg.world_size and reaches "
+                    f"`{sink.tail}` in an elastic class: after "
+                    "_reshard_world the mesh axis size is the RUNTIME "
+                    "world_size (survivor count), so the static config "
+                    "size no longer matches the axis",
+                    self.fix_hint,
+                    symbol=f"{fn.module}::{fn.qualname}",
+                )
+                break  # one canonical finding per function keeps the signal
+
+
+# --------------------------------------------------------------------------
+# G015 — sharding-spec flow
+
+
+class RuleG015:
+    code = "G015"
+    summary = (
+        "sharding spec carried across a function boundary into a stale or "
+        "unregistered placement (lowering spec A, dispatch spec B)"
+    )
+    fix_hint = (
+        "rebuild the sharding AFTER any reshard-reachable call (or key it "
+        "with the _aot_gen generation counter), and place dispatch operands "
+        "with the SAME spec the executable was lowered/AOT-registered "
+        "under — XLA treats a committed operand whose sharding differs "
+        "from the lowering spec as a new program (silent recompile) or "
+        "rejects it outright (the fused-lowering vs dispatch-seed incident)"
+    )
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        model = _get_model(ctx)
+        yield from self._check_stale_cross_function(ctx, model)
+        yield from self._check_registered_dispatch(ctx, model)
+
+    # -- (i) spec through a call, reshard, stale placement ------------------
+
+    def _check_stale_cross_function(
+        self, ctx, model: MeshModel
+    ) -> Iterator["Finding"]:
+        graph = ctx.graph
+        for fqn, fn in ctx.project.functions.items():
+            if fqn in model.mutators:
+                continue
+            edge_by_call = {id(e.call): e for e in graph.edges.get(fqn, ())}
+            edge_by_line = model.edges_by_line(fqn)
+            stmts = list(fn.stmts)
+            # spec-valued locals obtained THROUGH a call (the boundary G013
+            # cannot see: no mesh identifier appears in the bind)
+            derived: Dict[str, int] = {}
+            reshard_at: Optional[int] = None
+            for i, stmt in enumerate(stmts):
+                if reshard_at is not None:
+                    for call in stmt.calls:
+                        if call.tail not in PLACEMENT_SPEC_ARG:
+                            continue
+                        spec_pos = PLACEMENT_SPEC_ARG[call.tail]
+                        used: Optional[str] = None
+                        cand_tokens: List[str] = []
+                        if spec_pos < len(call.args) and call.args[spec_pos]:
+                            cand_tokens.append(call.args[spec_pos])
+                        for idents in call.arg_idents:
+                            cand_tokens.extend(
+                                t for t in derived if t in idents
+                            )
+                        for tok in cand_tokens:
+                            if tok in derived and derived[tok] < reshard_at:
+                                used = tok
+                                break
+                        if used is None:
+                            continue
+                        if _stmt_idents(stmt) & GEN_MARKERS:
+                            continue
+                        if ctx.suppressed(fn, self.code, call.line):
+                            continue
+                        yield _finding(
+                            self.code,
+                            ctx.path_of(fn),
+                            call.line,
+                            call.col,
+                            f"`{used}` holds a mesh-derived sharding "
+                            "obtained through a function call before the "
+                            f"re-shard on line {stmts[reshard_at].line} "
+                            f"can rebuild the mesh; `{call.tail}` then "
+                            "places with the STALE spec — the "
+                            "restore-onto-old-mesh shape, one function "
+                            "boundary deeper than G013 sees",
+                            self.fix_hint,
+                            symbol=f"{fn.module}::{fn.qualname}",
+                        )
+                        derived.pop(used, None)
+                bind = stmt.bind
+                if bind is not None:
+                    for tgt in bind.targets:
+                        derived.pop(tgt, None)
+                    if (
+                        bind.rhs_call_tail
+                        and bind.spec is None
+                        and not (bind.rhs_idents & MESH_ATTRS)
+                    ):
+                        e = edge_by_line.get((bind.rhs_call_tail, bind.line))
+                        if e is not None:
+                            info = model.spec_returns.get(e.callee)
+                            if info is not None and info[1]:
+                                for tgt in bind.targets:
+                                    if "." not in tgt:
+                                        derived[tgt] = i
+                for call in stmt.calls:
+                    e = edge_by_call.get(id(call))
+                    hits_reshard = (
+                        e is not None and e.callee in model.can_reshard
+                    ) or any(m in call.tail for m in RESHARD_MARKERS)
+                    if hits_reshard and reshard_at is None:
+                        reshard_at = i
+
+    # -- (ii) registered lowering specs vs dispatch placements --------------
+
+    def _check_registered_dispatch(
+        self, ctx, model: MeshModel
+    ) -> Iterator["Finding"]:
+        # per class: the spec identities its AOT-registration methods lower
+        # under, and every placement identity its dispatch methods use
+        registered: Dict[Tuple[str, str], Set[SpecId]] = {}
+        register_fns: Dict[Tuple[str, str], Set[str]] = {}
+        for fqn, fn in ctx.project.functions.items():
+            if not fn.cls:
+                continue
+            has_register = any(
+                c.tail in _REGISTER_TAILS
+                for stmt in fn.stmts
+                for c in stmt.calls
+            )
+            if not has_register:
+                continue
+            # the registration scope includes its nested closures: the
+            # engine funnels specs through `sds`/`win_spec` helpers defined
+            # inside the submit method
+            scope = [fn] + [
+                other
+                for other_fqn, other in ctx.project.functions.items()
+                if other.module == fn.module
+                and other.qualname.startswith(fn.qualname + ".")
+            ]
+            ids: Set[SpecId] = set()
+            for member in scope:
+                member_edges = model.edges_by_line(Project.fqn(member))
+                for stmt in member.stmts:
+                    for spec in MeshModel._stmt_specs(stmt):
+                        sid = model.spec_id(spec, member)
+                        if sid is not None:
+                            ids.add(sid)
+                    # specs obtained through spec-returning helpers count as
+                    # registered too — the dispatch side resolves them, so
+                    # the registration side must (symmetry, else the
+                    # class's own documented idiom reads as unregistered)
+                    bind = stmt.bind
+                    if (
+                        bind is not None
+                        and bind.spec is None
+                        and bind.rhs_call_tail
+                    ):
+                        e = member_edges.get((bind.rhs_call_tail, bind.line))
+                        info = (
+                            model.spec_returns.get(e.callee)
+                            if e is not None
+                            else None
+                        )
+                        if info is not None and info[0] is not None:
+                            ids.add(info[0])
+            if ids:
+                key = (fn.module, fn.cls)
+                registered.setdefault(key, set()).update(ids)
+                register_fns.setdefault(key, set()).update(
+                    Project.fqn(m) for m in scope
+                )
+        if not registered:
+            return
+        for fqn, fn in ctx.project.functions.items():
+            key = (fn.module, fn.cls)
+            if key not in registered or fn.is_setup:
+                continue
+            if fqn in register_fns.get(key, set()):
+                continue  # the registration side defines the set
+            reg = registered[key]
+            for stmt in fn.stmts:
+                for call in stmt.calls:
+                    spec_pos = PLACEMENT_SPEC_ARG.get(call.tail)
+                    if spec_pos is None:
+                        continue
+                    sid = self._placement_spec_id(model, fn, call, spec_pos)
+                    if sid is None or sid in reg:
+                        continue
+                    if _stmt_idents(stmt) & GEN_MARKERS:
+                        continue
+                    if ctx.suppressed(fn, self.code, call.line):
+                        continue
+                    yield _finding(
+                        self.code,
+                        ctx.path_of(fn),
+                        call.line,
+                        call.col,
+                        f"`{call.tail}` places a dispatch operand under "
+                        f"spec {sid} but this class's AOT lowerings "
+                        f"registered only {sorted(reg)} — a committed "
+                        "operand sharding the executable was not lowered "
+                        "for (the fused-lowering vs dispatch-seed "
+                        "mismatch)",
+                        self.fix_hint,
+                        symbol=f"{fn.module}::{fn.cls}",
+                    )
+
+    def _placement_spec_id(
+        self, model: MeshModel, fn: FunctionSummary, call: CallFact, pos: int
+    ) -> Optional[SpecId]:
+        if pos < len(call.spec_args) and call.spec_args[pos] is not None:
+            return model.spec_id(call.spec_args[pos], fn)
+        tok = call.args[pos] if pos < len(call.args) else None
+        if not tok:
+            return None
+        # local spec binding (ctor or spec-returning helper call)
+        edge_by_line = model.edges_by_line(Project.fqn(fn))
+        sid: Optional[SpecId] = None
+        for stmt in fn.stmts:
+            if stmt.line >= call.line:
+                break
+            bind = stmt.bind
+            if bind is None or tok not in bind.targets:
+                continue
+            if bind.spec is not None:
+                sid = model.spec_id(bind.spec, fn)
+            elif bind.rhs_call_tail:
+                e = edge_by_line.get((bind.rhs_call_tail, bind.line))
+                info = model.spec_returns.get(e.callee) if e else None
+                sid = info[0] if info else None
+            else:
+                sid = None
+        return sid
+
+
+# --------------------------------------------------------------------------
+# G016 — non-uniform shard arithmetic
+
+
+class RuleG016:
+    code = "G016"
+    summary = (
+        "unequal per-worker shard value reaches a fixed-shape collective "
+        "or on-device concat without the pad/quantize discipline"
+    )
+    fix_hint = (
+        "route plan-derived sizes through the ladder discipline "
+        "(quantize_batches/snap_to_bucket, pad to _cap_b/_cap_packed) "
+        "before they shape anything a collective or device concat sees — "
+        "DBS shards are UNEQUAL by design, and XLA collectives require "
+        "every participant to contribute the same shape (unequal shards "
+        "either fail to trace or silently truncate)"
+    )
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        from dynamic_load_balance_distributeddnn_tpu.analysis.rules import (
+            _BUCKET_MARKERS,
+        )
+
+        model = _get_model(ctx)
+        cleanse = set(_BUCKET_MARKERS) | {"pad", "padded", "pads"}
+        graph = ctx.graph
+
+        # per-function transfer facts: which params reach a sink, whether
+        # the return carries plan taint, and the local findings
+        sink_params: Dict[str, Set[int]] = {}
+        tainted_returns: Set[str] = set()
+        local_sites: Dict[str, List[Tuple[CallFact, str]]] = {}
+        for _ in range(6):
+            changed = False
+            for fqn, fn in ctx.project.functions.items():
+                sp, tr, sites = self._flow_function(
+                    model, graph, fn, cleanse, sink_params, tainted_returns
+                )
+                if sp != sink_params.get(fqn, set()):
+                    sink_params[fqn] = sp
+                    changed = True
+                if tr and fqn not in tainted_returns:
+                    tainted_returns.add(fqn)
+                    changed = True
+                local_sites[fqn] = sites
+            if not changed:
+                break
+
+        for fqn, fn in ctx.project.functions.items():
+            path = ctx.path_of(fn)
+            for call, tok in local_sites.get(fqn, ()):
+                if ctx.suppressed(fn, self.code, call.line):
+                    continue
+                yield _finding(
+                    self.code,
+                    path,
+                    call.line,
+                    call.col,
+                    f"`{tok}` derives from the DBS plan's unequal "
+                    f"per-worker shard sizes and flows into `{call.tail}` "
+                    "without passing the pad/quantize discipline — "
+                    "fixed-shape collectives need every worker's "
+                    "contribution to be the same shape",
+                    self.fix_hint,
+                    symbol=f"{fn.module}::{fn.qualname}",
+                )
+
+    def _flow_function(
+        self,
+        model: MeshModel,
+        graph: CallGraph,
+        fn: FunctionSummary,
+        cleanse: Set[str],
+        sink_params: Dict[str, Set[int]],
+        tainted_returns: Set[str],
+    ) -> Tuple[Set[int], bool, List[Tuple[CallFact, str]]]:
+        fqn = Project.fqn(fn)
+        edge_by_call = {id(e.call): e for e in graph.edges.get(fqn, ())}
+        edge_by_line = model.edges_by_line(fqn)
+        param_origin = {p: frozenset({p}) for p in fn.params}
+        taint: Dict[str, FrozenSet[str]] = {}
+        hit_params: Set[int] = set()
+        local_hits: List[Tuple[CallFact, str]] = []
+        ret_tainted = False
+        param_index = {p: i for i, p in enumerate(fn.params)}
+
+        def origins_of(idents: FrozenSet[str]) -> FrozenSet[str]:
+            out: Set[str] = set()
+            if idents & UNEQUAL_SOURCE_IDENTS:
+                out.add(_LOCAL_ORIGIN)
+            for name in idents:
+                if name in taint:
+                    out |= taint[name]
+            return frozenset(out)
+
+        for stmt in fn.stmts:
+            for call in stmt.calls:
+                if self._is_sink(call):
+                    for pos, idents in enumerate(call.arg_idents):
+                        orgs = origins_of(idents)
+                        if idents & cleanse:
+                            continue
+                        if _LOCAL_ORIGIN in orgs:
+                            tok = call.args[pos] or sorted(
+                                idents & (UNEQUAL_SOURCE_IDENTS | set(taint))
+                            )[0]
+                            local_hits.append((call, tok))
+                        for org in orgs:
+                            if org in param_index:
+                                hit_params.add(param_index[org])
+                        # a param handed to the sink directly
+                        for name in idents & set(param_index):
+                            hit_params.add(param_index[name])
+                # interprocedural sink: callee feeds param into a collective
+                e = edge_by_call.get(id(call))
+                if e is not None:
+                    callee_sinks = sink_params.get(e.callee, set())
+                    for pidx in callee_sinks:
+                        pos = pidx - e.param_offset
+                        if not (0 <= pos < len(call.arg_idents)):
+                            continue
+                        idents = call.arg_idents[pos]
+                        if idents & cleanse:
+                            continue
+                        orgs = origins_of(idents)
+                        if _LOCAL_ORIGIN in orgs:
+                            tok = call.args[pos] or sorted(idents)[0]
+                            local_hits.append((call, tok))
+                        for org in orgs:
+                            if org in param_index:
+                                hit_params.add(param_index[org])
+                        # our own param handed straight into the callee's
+                        # sink position: the chain must keep climbing
+                        for name in idents & set(param_index):
+                            hit_params.add(param_index[name])
+            bind = stmt.bind
+            if bind is None:
+                continue
+            idents = bind.rhs_idents
+            if idents & cleanse:
+                for tgt in bind.targets:
+                    taint.pop(tgt, None)
+                continue
+            orgs: Set[str] = set(origins_of(idents))
+            if bind.rhs_call_tail in UNEQUAL_SOURCE_TAILS:
+                orgs.add(_LOCAL_ORIGIN)
+            elif bind.rhs_call_tail:
+                e = edge_by_line.get((bind.rhs_call_tail, bind.line))
+                if e is not None and e.callee in tainted_returns:
+                    orgs.add(_LOCAL_ORIGIN)
+            # param identity flows through plain alias binds only
+            for src in bind.alias_sources:
+                base = src.split(".", 1)[0]
+                if base in param_origin:
+                    orgs |= param_origin[base]
+            for tgt in bind.targets:
+                if orgs:
+                    taint[tgt] = frozenset(orgs)
+                else:
+                    taint.pop(tgt, None)
+        for stmt in fn.stmts:
+            if stmt.ret is None:
+                continue
+            for tok in stmt.ret.alias_tokens:
+                if _LOCAL_ORIGIN in taint.get(tok, frozenset()):
+                    ret_tainted = True
+        return hit_params, ret_tainted, local_hits
+
+    @staticmethod
+    def _is_sink(call: CallFact) -> bool:
+        if call.tail in FIXED_SHAPE_COLLECTIVES:
+            return True
+        return call.tail in _DEVICE_CONCAT_TAILS and call.name.startswith(
+            _DEVICE_NS
+        )

@@ -23,7 +23,9 @@ actually shipped (single-file G001-G010 could not see any of them):
 
 All three run on the :class:`~.project.Project` + :class:`~.callgraph.CallGraph`
 pair — no ASTs, only summaries — so the whole-program pass stays cacheable
-and cheap (tests/test_graftflow.py budgets the full-repo run).
+and cheap (tests/test_graftflow.py budgets the full-repo run). The
+graftmesh families G014-G016 (flow/mesh.py) register into FLOW_RULES below
+and run on the same pair, with a shared per-run :class:`~.mesh.MeshModel`.
 """
 
 from __future__ import annotations
@@ -36,6 +38,14 @@ from dynamic_load_balance_distributeddnn_tpu.analysis.flow.ir import (
     FunctionSummary,
     ModuleSummary,
     StmtFact,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.mesh import (
+    GEN_MARKERS,
+    MESH_ATTRS,
+    RuleG014,
+    RuleG015,
+    RuleG016,
+    reshard_surface,
 )
 from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import Project
 
@@ -55,7 +65,14 @@ def _finding(code, path, line, col, message, fix_hint, symbol=""):
 
 
 def _mutually_exclusive(a: StmtFact, b: StmtFact) -> bool:
-    ga, gb = dict(a.guards), dict(b.guards)
+    return _guards_exclusive(a.guards, b.guards)
+
+
+def _guards_exclusive(
+    ga_t: Tuple[Tuple[int, str], ...], gb_t: Tuple[Tuple[int, str], ...]
+) -> bool:
+    """Two guard tuples sit in different arms of the same If."""
+    ga, gb = dict(ga_t), dict(gb_t)
     return any(ga[k] != gb[k] for k in ga.keys() & gb.keys())
 
 
@@ -165,14 +182,24 @@ class RuleG011:
         if not sites:
             return
 
-        # forward alias groups at each statement index
+        # forward alias groups at each statement index, plus the guards of
+        # EVERY bind site of each token: an alias bound only in one If arm
+        # must not survive into the OTHER arm's analysis (the
+        # branch-sensitivity gap PR 7 recorded) — but a token also bound
+        # unconditionally still aliases on the other arm's path, so a token
+        # is excluded only when ALL its recorded binds are exclusive with
+        # the donation (an alias-breaking rebind resets the record: past
+        # binds are dead on every path through it)
         stmts = list(fn.stmts)
         index_of = {id(s): i for i, s in enumerate(stmts)}
         groups: Dict[str, Set[str]] = {}
+        bind_guards: Dict[str, List[Tuple[Tuple[int, str], ...]]] = {}
         groups_at: List[Dict[str, Set[str]]] = []
+        bind_guards_at: List[Dict[str, List[Tuple[Tuple[int, str], ...]]]] = []
         for stmt in stmts:
             # snapshot BEFORE the statement's own bind applies
             groups_at.append({k: set(v) for k, v in groups.items()})
+            bind_guards_at.append({k: list(v) for k, v in bind_guards.items()})
             bind = stmt.bind
             if bind is None:
                 continue
@@ -191,9 +218,12 @@ class RuleG011:
                 new_group = srcs | set(bind.targets)
                 for member in new_group:
                     groups[member] = new_group
+                for tgt in bind.targets:
+                    bind_guards.setdefault(tgt, []).append(stmt.guards)
             else:
                 for tgt in bind.targets:
                     groups.pop(tgt, None)
+                    bind_guards[tgt] = [stmt.guards]
 
         for stmt, call, token, kind in sites:
             i = index_of.get(id(stmt))
@@ -207,6 +237,19 @@ class RuleG011:
                 graph.origins_at(fn, stmt), edge_by_call,
             )
             killed = self._alias_closure(groups_at[i], token) | {token}
+            # branch sensitivity: a token whose EVERY recorded bind sits in
+            # a mutually-exclusive If arm never coexists with this donation;
+            # one unconditional (or same-arm) bind keeps it killed
+            killed = {
+                tok
+                for tok in killed
+                if tok == token
+                or not bind_guards_at[i].get(tok)
+                or not all(
+                    _guards_exclusive(g, stmt.guards)
+                    for g in bind_guards_at[i][tok]
+                )
+            }
             if stmt.bind is not None:
                 # x = f(x, ...) is the safe donate-and-rebind idiom — but
                 # only for the names actually rebound: an alias taken
@@ -521,8 +564,8 @@ class RuleG013:
         "_reshard_world rebuilt the mesh"
     )
 
-    _MESH_ATTRS = {"mesh", "_mesh"}
-    _GEN_MARKERS = {"_aot_gen", "aot_gen", "generation"}
+    _MESH_ATTRS = MESH_ATTRS  # ONE definition of "a mesh attribute" (mesh.py)
+    _GEN_MARKERS = GEN_MARKERS  # likewise for the generation-key sanction
     _PLACEMENT_TAILS = {
         "device_put",
         "device_put_sharded",
@@ -532,32 +575,16 @@ class RuleG013:
     _RESHARD_MARKERS = ("reshard", "_reshard")
 
     def check(self, ctx: _FlowContext) -> Iterator["Finding"]:
-        graph = ctx.graph
-        # mesh mutators: non-setup functions that rebind a mesh attr
-        mutators: List[str] = []
-        for fqn, fn in ctx.project.functions.items():
-            if fn.is_setup or not fn.cls:
-                continue
-            for stmt in fn.stmts:
-                for acc in stmt.attr_accesses:
-                    if acc.write and acc.attr in self._MESH_ATTRS:
-                        mutators.append(fqn)
-                        break
-                else:
-                    continue
-                break
-        if not mutators:
+        # mesh mutators + reverse reachability: the shared definition from
+        # mesh.py, ctx-memoized — but NOT via the full MeshModel, so a
+        # `--select G013` run does not pay the graftmesh fixpoints
+        pair = getattr(ctx, "_reshard_surface", None)
+        if pair is None:
+            pair = reshard_surface(ctx.project, ctx.graph)
+            ctx._reshard_surface = pair
+        mutator_set, can_reshard = pair
+        if not mutator_set:
             return
-        mutator_set = set(mutators)
-        # functions from which a mutator is reachable (reverse reachability)
-        can_reshard: Set[str] = set(mutator_set)
-        frontier = list(mutator_set)
-        while frontier:
-            cur = frontier.pop()
-            for e in graph.callers.get(cur, ()):
-                if e.caller not in can_reshard:
-                    can_reshard.add(e.caller)
-                    frontier.append(e.caller)
 
         yield from self._check_stale_attrs(ctx, mutator_set)
         yield from self._check_local_staleness(ctx, can_reshard, mutator_set)
@@ -698,7 +725,15 @@ class RuleG013:
 
 
 FLOW_RULES: Dict[str, object] = {
-    r.code: r for r in (RuleG011(), RuleG012(), RuleG013())
+    r.code: r
+    for r in (
+        RuleG011(),
+        RuleG012(),
+        RuleG013(),
+        RuleG014(),
+        RuleG015(),
+        RuleG016(),
+    )
 }
 
 

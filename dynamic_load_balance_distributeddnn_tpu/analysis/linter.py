@@ -6,10 +6,12 @@ Two analysis tiers share this driver:
 * **single-file rules** (rules.py G001-G010): a rule either matches a
   structural pattern in one module or stays quiet — no import resolution,
   no type inference.
-* **whole-program flow rules** (flow/ G011-G013, ``flow=True``): every file
+* **whole-program flow rules** (flow/ G011-G016, ``flow=True``): every file
   is lowered to a picklable summary, a call graph propagates facts across
   functions/threads/modules, and the flow rules check donation lifetimes,
-  thread/lock discipline, and stale-mesh placement.
+  thread/lock discipline, stale-mesh placement, and (graftmesh, flow/mesh.py)
+  collective/axis consistency, sharding-spec flow, and non-uniform shard
+  arithmetic.
 
 Both tiers are **content-hash cached** (per-file findings and per-module
 summaries keyed by sha256) and the per-file work fans out over a process
@@ -37,7 +39,7 @@ from dynamic_load_balance_distributeddnn_tpu.analysis.astutil import (
 )
 
 # Bump on ANY rule/semantics change: stale cached findings must miss.
-LINT_SCHEMA_VERSION = "gl2"
+LINT_SCHEMA_VERSION = "gl3"
 
 
 @dataclass(frozen=True)
@@ -219,7 +221,7 @@ def lint_paths(
     ``jobs``: 0 = auto (process-parallel above a handful of files), 1 =
     serial, N = pool width. ``cache_dir``: content-hash cache for per-file
     findings and flow summaries (None disables). ``flow``: additionally run
-    the whole-program rules (G011-G013) over ALL the files as one program.
+    the whole-program rules (G011-G016) over ALL the files as one program.
     """
     from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import (
         Project,

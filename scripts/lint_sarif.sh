@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# CI lint annotation: run the full graftlint pass (single-file G001-G010 +
+# whole-program flow G011-G016) and emit SARIF 2.1.0 so the CI can annotate
+# PR diffs per-line (GitHub: upload with codeql-action/upload-sarif or any
+# SARIF ingester; the region startLine/startColumn map straight onto diff
+# positions).
+#
+# Usage:  scripts/lint_sarif.sh [output.sarif]
+#
+# Exit status is graftlint's own: 0 clean, 1 findings (fail the check),
+# 2 usage/parse errors — so the step can gate merges directly.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-artifacts/lint.sarif}"
+mkdir -p "$(dirname "$OUT")"
+python -m dynamic_load_balance_distributeddnn_tpu.analysis.cli \
+    --flow --format sarif \
+    dynamic_load_balance_distributeddnn_tpu bench.py > "$OUT"
+rc=$?
+count=$(python - "$OUT" <<'EOF'
+import json, sys
+with open(sys.argv[1]) as fh:
+    sarif = json.load(fh)
+print(sum(len(r.get("results", [])) for r in sarif.get("runs", [])))
+EOF
+)
+echo "graftlint: $count finding(s) -> $OUT (exit $rc)" >&2
+exit "$rc"

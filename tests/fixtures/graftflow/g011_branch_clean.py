@@ -1,0 +1,28 @@
+"""G011 branch-sensitivity negative: the false positive PR 7's ROADMAP
+recorded, now closed. The alias is bound in one If arm and the donation
+happens in the OTHER — the two never coexist on any path, so the read
+after the If is safe:
+
+* fast path: ``snap = state`` but nothing donates
+* slow path: ``state`` is donated-and-rebound, but ``snap`` was never
+  bound to it (it holds the fresh zeros value)
+
+Before branch-aware alias groups, the linear alias pass let the fast
+path's ``snap = state`` survive into the slow path's donation analysis and
+flagged the final read."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda s, g: s - g, donate_argnums=(0,))
+
+
+def window(state, grads, fastpath):
+    if fastpath:
+        snap = state  # alias on the non-donating path only
+        out = jnp.sum(snap)
+    else:
+        snap = jnp.zeros(())
+        state = step(state, grads)  # donation on the aliasing-free path
+        out = jnp.sum(state)
+    return state, out, snap

@@ -1,0 +1,17 @@
+"""G011 branch-sensitivity seed (positive twin of g011_branch_clean.py):
+the alias and the donation share the SAME If arm, so the path through the
+arm really does read a donated buffer — branch-aware alias groups must
+still fire here."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda s, g: s - g, donate_argnums=(0,))
+
+
+def window(state, grads, fastpath):
+    if fastpath:
+        snap = state  # alias in the SAME arm as the donation
+        state = step(state, grads)
+        return state, jnp.sum(snap)  # snap still points at the donated buffer
+    return state, jnp.zeros(())

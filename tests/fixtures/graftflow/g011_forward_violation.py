@@ -1,0 +1,40 @@
+"""G011 forwarding seeds: donation facts crossing the two channels PR 7's
+ROADMAP recorded as modeling gaps, now closed.
+
+Shape 1 (**kwargs forwarding): ``outer`` forwards its ``**kw`` verbatim to
+``inner``, which donates its ``state`` parameter — so ``top``'s explicit
+``state=state`` keyword dies at the call, and the later read is a
+use-after-free positional argnums could never express.
+
+Shape 2 (tree_map lambda): the donor is dispatched per-leaf from inside a
+``jax.tree_util.tree_map`` lambda — the mapped TREES are donated, and the
+alias taken before the map still points at the dead buffers.
+"""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda s, g: s - g, donate_argnums=(0,))
+
+
+def inner(state, batch):
+    return step(state, batch)
+
+
+def outer(**kw):
+    return inner(**kw)
+
+
+def top(state, batch):
+    out = outer(state=state, batch=batch)
+    return out, jnp.sum(state)  # donated through the ** forwarding chain
+
+
+def leaf_update(s, g):
+    return step(s, g)
+
+
+def window(state, grads):
+    snap = state  # alias taken before the per-leaf donation
+    new = jax.tree_util.tree_map(lambda s, g: leaf_update(s, g), state, grads)
+    return new, jnp.sum(snap)

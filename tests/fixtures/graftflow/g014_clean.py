@@ -1,0 +1,50 @@
+"""G014 negatives: the sanctioned axis disciplines.
+
+* collectives name axes a mesh construction actually defines (through the
+  module-constant indirection — ``AXIS = "data"`` resolves)
+* shard_map's mesh carries every axis the mapped function demands
+* the elastic class sizes mesh-shaped values from the RUNTIME
+  ``self.world_size`` the re-shard rebinds, not the static config
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS = "data"
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def combine(tree):
+    return jax.lax.psum(tree, AXIS)
+
+
+def body(x):
+    return jax.lax.psum(x, "data")
+
+
+def wire(devices):
+    mesh = make_mesh(devices)
+    return jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+
+
+class Engine:
+    def __init__(self, cfg, devices):
+        self.cfg = cfg
+        self.mesh = make_mesh(devices)
+        self.world_size = cfg.world_size
+
+    def _reshard_world(self, active):
+        self.world_size = len(active)
+        self.mesh = make_mesh(active)
+
+    def stage_slow(self, faults):
+        slow = np.zeros(self.world_size, np.int32)
+        return jax.device_put(slow, stacked_sharding(self.mesh, "data"))
+
+
+def stacked_sharding(mesh, axis):
+    return object()

@@ -1,0 +1,55 @@
+"""G014 seeds: collective/axis consistency, three shapes.
+
+Shape 1 (axis universe): ``combine`` psums over axis ``"dat"`` — a typo no
+mesh construction in the program defines (the only mesh carries ``"data"``).
+
+Shape 2 (shard_map supply vs demand): ``wire`` maps ``body`` over a 1-D
+``("data",)`` mesh, but ``body``'s collective requires axis ``"model"`` —
+the interprocedural check: the axis use and the mesh live in different
+functions.
+
+Shape 3 (elastic size assumption): ``Engine._reshard_world`` rebuilds the
+mesh from the RUNTIME survivor fleet, yet ``stage_slow`` sizes a
+mesh-sharded vector from ``cfg.world_size`` — after a downsizing re-shard
+the static config size no longer matches the mesh axis (the PR-6 class of
+bug, size flavor).
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))
+
+
+def combine(tree):
+    return jax.lax.psum(tree, "dat")  # no mesh defines 'dat'
+
+
+def body(x):
+    return jax.lax.psum(x, "model")  # demanded axis
+
+
+def wire(devices):
+    mesh = make_mesh(devices)
+    return jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+
+
+class Engine:
+    def __init__(self, cfg, devices):
+        self.cfg = cfg
+        self.mesh = make_mesh(devices)
+
+    def _reshard_world(self, active):
+        self.mesh = make_mesh(active)  # runtime fleet sizes the axis
+
+    def stage_slow(self, faults):
+        cfg = self.cfg
+        slow = np.zeros(cfg.world_size, np.int32)
+        return jax.device_put(slow, stacked_sharding(self.mesh, "data"))
+
+
+def stacked_sharding(mesh, axis):
+    return object()

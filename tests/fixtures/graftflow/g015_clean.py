@@ -1,0 +1,49 @@
+"""G015 negatives: the sanctioned spec-flow disciplines.
+
+* rebuild the helper-obtained sharding AFTER the possible re-shard
+* dispatch placements use the SAME spec identity the AOT lowering
+  registered
+* generation-keyed placements (``_aot_gen`` in the statement) are
+  sanctioned: stale entries can never resolve
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Engine:
+    def __init__(self, devices):
+        self.mesh = Mesh(np.array(devices), ("data",))
+        self._aot = object()
+        self._aot_gen = 0
+
+    def _sharding_for_state(self):
+        return NamedSharding(self.mesh, P())
+
+    def _reshard_world(self, active):
+        self.mesh = Mesh(np.array(active), ("data",))
+        self._aot_gen += 1
+
+    def resume(self, ckpt, active):
+        if ckpt.active != active:
+            self._reshard_world(active)
+        sh = self._sharding_for_state()  # rebuilt AFTER the re-shard
+        return jax.device_put(ckpt.state, sh)
+
+    def _submit_aot(self, state):
+        seed_t = jax.ShapeDtypeStruct(
+            (), jnp.int32, sharding=NamedSharding(self.mesh, P())
+        )
+        self._aot.submit(("fused", self._aot_gen), state, (seed_t,))
+
+    def _dispatch(self, epoch):
+        seed = jax.device_put(
+            jnp.int32(epoch), NamedSharding(self.mesh, P())
+        )  # matches the registered lowering spec
+        return seed
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))

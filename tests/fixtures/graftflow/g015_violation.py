@@ -1,0 +1,55 @@
+"""G015 seeds: sharding-spec flow, the two motivating incidents.
+
+Shape 1 (cross-function stale spec — the PR-6 restore-onto-old-mesh crash,
+one function boundary deeper than G013 sees): ``resume`` obtains the state
+sharding THROUGH ``_sharding_for_state`` (so no mesh identifier appears in
+the bind and G013's local-capture rule is blind), then the elastic branch
+re-shards, then ``device_put`` places with the pre-reshard spec —
+replicated over the ORIGINAL device set, mixed-device crash at the first
+combine.
+
+Shape 2 (lowering-spec vs dispatch-placement mismatch — the fused-AOT seed
+incident): ``_submit_aot`` lowers the executable from specs registered
+replicated (``P()``), but ``_dispatch`` commits the operand under
+``P("data")`` — a sharding the executable was never lowered for, so
+dispatch either recompiles silently or rejects the operand.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Engine:
+    def __init__(self, devices):
+        self.mesh = Mesh(np.array(devices), ("data",))
+        self._aot = object()
+
+    def _sharding_for_state(self):
+        return NamedSharding(self.mesh, P())
+
+    def _reshard_world(self, active):
+        self.mesh = Mesh(np.array(active), ("data",))
+
+    def resume(self, ckpt, active):
+        sh = self._sharding_for_state()  # captured THROUGH the helper
+        if ckpt.active != active:
+            self._reshard_world(active)
+        return jax.device_put(ckpt.state, sh)  # STALE pre-reshard spec
+
+    def _submit_aot(self, state):
+        seed_t = jax.ShapeDtypeStruct(
+            (), jnp.int32, sharding=NamedSharding(self.mesh, P())
+        )
+        self._aot.submit(("fused", 0), state, (seed_t,))
+
+    def _dispatch(self, epoch):
+        seed = jax.device_put(
+            jnp.int32(epoch), NamedSharding(self.mesh, P("data"))
+        )  # lowered under P(), dispatched under P("data")
+        return seed
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))

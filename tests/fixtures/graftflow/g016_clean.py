@@ -1,0 +1,40 @@
+"""G016 negatives: the pad/quantize discipline.
+
+* plan widths snapped by ``quantize_batches`` live on the bucket ladder —
+  every worker's contribution is a fixed multiple of the bucket
+* shards padded to the capacity width (``pad_to``/``_cap_b`` channel)
+  before stacking are uniform by construction
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))
+
+
+def integer_batch_split(shares, global_batch):
+    return np.maximum((shares * global_batch).astype(np.int64), 1)
+
+
+def quantize_batches(batches, bucket, global_batch):
+    return np.maximum(batches // bucket, 1) * bucket
+
+
+def pack(parts, batch_sizes, pad_to):
+    shards = [np.pad(p, (0, pad_to - len(p))) for p in parts]  # padded
+    stacked = jnp.stack(shards)
+    return jax.lax.all_gather(stacked, "data")
+
+
+def gather_all(vec):
+    return jax.lax.all_gather(vec, "data")
+
+
+def epoch(shares, global_batch, bucket):
+    batches = integer_batch_split(shares, global_batch)
+    snapped = quantize_batches(batches, bucket, global_batch)
+    return gather_all(snapped)

@@ -1,0 +1,43 @@
+"""G016 seeds: non-uniform shard arithmetic, two shapes.
+
+DBS plans are UNEQUAL by design — the solver's per-worker batch sizes
+differ until the pad/quantize discipline snaps them to the bucket ladder.
+
+Shape 1 (local): ``pack`` slices per-worker shards to their raw plan
+widths, then stacks and all_gathers them — XLA collectives need every
+participant to contribute the same shape, so the unequal shards either
+fail to trace or silently truncate.
+
+Shape 2 (interprocedural): ``epoch`` hands the raw
+``integer_batch_split`` output to ``gather_all``, whose body feeds its
+parameter into a fixed-shape collective — the taint and the sink live in
+different functions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))
+
+
+def integer_batch_split(shares, global_batch):
+    return np.maximum((shares * global_batch).astype(np.int64), 1)
+
+
+def pack(parts, batch_sizes):
+    shards = [p[:b] for p, b in zip(parts, batch_sizes)]  # raw plan widths
+    stacked = jnp.stack(shards)
+    return jax.lax.all_gather(stacked, "data")
+
+
+def gather_all(vec):
+    return jax.lax.all_gather(vec, "data")  # fixed-shape sink
+
+
+def epoch(shares, global_batch):
+    batches = integer_batch_split(shares, global_batch)
+    return gather_all(batches)  # unequal widths cross the call boundary

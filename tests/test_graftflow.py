@@ -52,6 +52,10 @@ def codes(findings):
         ("g012_violation.py", "G012", 2),
         # stale local capture + never-invalidated derived attr
         ("g013_violation.py", "G013", 2),
+        # alias + donation in the SAME If arm (branch-aware groups still fire)
+        ("g011_branch_violation.py", "G011", 1),
+        # donation through **kwargs forwarding + tree_map lambda dispatch
+        ("g011_forward_violation.py", "G011", 2),
     ],
 )
 def test_flow_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -65,7 +69,14 @@ def test_flow_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings)
 
 
 @pytest.mark.parametrize(
-    "fixture", ["g011_clean.py", "g012_clean.py", "g013_clean.py"]
+    "fixture",
+    [
+        "g011_clean.py",
+        "g012_clean.py",
+        "g013_clean.py",
+        # the recorded branch-sensitivity false positive, now closed
+        "g011_branch_clean.py",
+    ],
 )
 def test_clean_fixture_is_quiet(fixture):
     path = str(FIXTURES / fixture)
@@ -361,6 +372,142 @@ def test_g011_chained_assignment_aliases_every_target():
         "    return state, jnp.sum(snap)\n"
     )
     assert codes(analyze_source(src)) == {"G011"}
+
+
+def test_branch_exclusive_alias_does_not_survive_into_other_arm():
+    """ROADMAP gap closed: `snap = state` in the fast arm must not make the
+    slow arm's donation kill `snap` — the two never coexist on any path."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "f = jax.jit(lambda s, g: s - g, donate_argnums=(0,))\n"
+        "def window(state, g, flag):\n"
+        "    if flag:\n"
+        "        snap = state\n"
+        "        out = jnp.sum(snap)\n"
+        "    else:\n"
+        "        snap = jnp.zeros(())\n"
+        "        out = f(state, g)\n"
+        "    return out, jnp.sum(snap)\n"
+    )
+    assert analyze_source(src) == []
+    # the positive control: same-arm alias + donation still fires
+    same_arm = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "f = jax.jit(lambda s, g: s - g, donate_argnums=(0,))\n"
+        "def window(state, g, flag):\n"
+        "    if flag:\n"
+        "        snap = state\n"
+        "        out = f(state, g)\n"
+        "        return out, jnp.sum(snap)\n"
+        "    return state, jnp.zeros(())\n"
+    )
+    assert codes(analyze_source(same_arm)) == {"G011"}
+
+
+def test_unconditional_alias_survives_exclusive_arm_rebind():
+    """A token ALSO bound unconditionally still aliases on the donation
+    path — only tokens whose every bind is exclusive with the donation arm
+    are branch-filtered (last-write-wins would un-catch the incident)."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "f = jax.jit(lambda s, g: s - g, donate_argnums=(0,))\n"
+        "def window(state, g, flag):\n"
+        "    snap = state\n"
+        "    if flag:\n"
+        "        snap = state\n"
+        "        out = jnp.sum(snap)\n"
+        "    else:\n"
+        "        out = f(state, g)\n"
+        "    return out, jnp.sum(snap)\n"
+    )
+    assert codes(analyze_source(src)) == {"G011"}
+
+
+def test_donation_propagates_through_kwargs_forwarding():
+    """ROADMAP gap closed: ``outer(**kw)`` forwarding to a donor means
+    outer's callers see their explicit keyword arguments die."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "f = jax.jit(lambda s, g: s - g, donate_argnums=(0,))\n"
+        "def inner(state, batch):\n"
+        "    return f(state, batch)\n"
+        "def outer(**kw):\n"
+        "    return inner(**kw)\n"
+        "def top(state, batch):\n"
+        "    out = outer(state=state, batch=batch)\n"
+        "    return out, jnp.sum(state)\n"
+    )
+    proj = Project.from_summaries([summarize_source(src, "m.py")])
+    graph = CallGraph(proj)
+    assert graph.donated_kwnames["m::outer"] == {"state": 7}
+    assert 0 in graph.donated_params["m::top"]
+    assert codes(analyze_source(src)) == {"G011"}
+
+
+def test_kwargs_forwarding_skips_own_shadowing_param():
+    """An own named param of the forwarder CAPTURES the keyword — the
+    caller's ``state=...`` binds it and never reaches **kw, so the caller's
+    value is not donated (the copy breaks the chain)."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "f = jax.jit(lambda s, g: s - g, donate_argnums=(0,))\n"
+        "def inner(state, batch):\n"
+        "    return f(state, batch)\n"
+        "def outer(state, **kw):\n"
+        "    return inner(jnp.array(state, copy=True), **kw)\n"
+        "def top(s, batch):\n"
+        "    out = outer(state=s, batch=batch)\n"
+        "    return out, jnp.sum(s)\n"
+    )
+    proj = Project.from_summaries([summarize_source(src, "m.py")])
+    graph = CallGraph(proj)
+    assert "state" not in graph.donated_kwnames["m::outer"]
+    assert analyze_source(src) == []
+
+
+def test_donation_propagates_through_tree_map_lambda():
+    """ROADMAP gap closed: a donor dispatched per-leaf from a tree_map
+    lambda donates the mapped trees."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "f = jax.jit(lambda s, g: s - g, donate_argnums=(0,))\n"
+        "def leaf(s, g):\n"
+        "    return f(s, g)\n"
+        "def window(state, grads):\n"
+        "    snap = state\n"
+        "    new = jax.tree_util.tree_map(lambda s, g: leaf(s, g), state, grads)\n"
+        "    return new, jnp.sum(snap)\n"
+    )
+    findings = analyze_source(src)
+    assert codes(findings) == {"G011"}, findings
+
+
+def test_g012_inventories_partial_bound_thread_targets():
+    """ROADMAP gap closed: Thread(target=functools.partial(self._run, x))
+    and pool.submit(functools.partial(f, a)) resolve their spawn edges."""
+    src = (
+        "import threading\n"
+        "import functools\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(\n"
+        "            target=functools.partial(self._run, 3))\n"
+        "    def _run(self, n):\n"
+        "        self._count = n\n"
+        "    def read(self):\n"
+        "        self._count = 0\n"
+    )
+    proj = Project.from_summaries([summarize_source(src, "s.py")])
+    graph = CallGraph(proj)
+    thread_side, _main = graph.thread_sides()
+    assert "s::S._run" in thread_side
+    assert codes(analyze_source(src)) == {"G012"}
 
 
 def test_baseline_keys_agree_across_path_spellings(tmp_path):

@@ -1,0 +1,477 @@
+"""graftmesh (whole-program sharding/collective semantics) tests: the G014-
+G016 rule families must trip on their seeded fixtures — including minimized
+reproductions of BOTH motivating incidents (PR 6's restore-onto-the-old-mesh
+placement, caught one function boundary deeper than G013 sees, and the
+fused-AOT lowering-spec vs dispatch-seed placement mismatch) — the clean
+twins must stay quiet, the MeshModel engine (axis universe, mesh-environment
+lattice, required-axes fixpoint, spec identities) must hold its contracts,
+and the pass must stay inside graftflow's runtime budget.
+"""
+
+import pathlib
+import time
+
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow import (
+    CallGraph,
+    Project,
+    analyze_paths,
+    analyze_source,
+    summarize_source,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.mesh import (
+    MeshModel,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.linter import (
+    lint_file,
+    lint_paths,
+)
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "graftflow"
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PKG = REPO / "dynamic_load_balance_distributeddnn_tpu"
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+def model_of(src: str, path: str = "m.py") -> MeshModel:
+    proj = Project.from_summaries([summarize_source(src, path)])
+    return MeshModel(proj, CallGraph(proj))
+
+
+# ------------------------------------------------------------ seeded fixtures
+
+
+@pytest.mark.parametrize(
+    "fixture,expected_code,min_findings",
+    [
+        # unknown axis + shard_map supply/demand + elastic cfg size
+        ("g014_violation.py", "G014", 4),
+        # cross-boundary stale spec + lowering-vs-dispatch mismatch
+        ("g015_violation.py", "G015", 2),
+        # local unequal-shard sink + interprocedural param sink
+        ("g016_violation.py", "G016", 3),
+    ],
+)
+def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
+    findings = analyze_paths([str(FIXTURES / fixture)])
+    hits = [f for f in findings if f.code == expected_code]
+    assert len(hits) >= min_findings, (fixture, findings)
+    # a seeded fixture must not also trip unrelated flow rules (noise)
+    assert codes(findings) == {expected_code}, findings
+    # nor any single-file rule — each corpus file isolates ONE bug class
+    assert lint_file(str(FIXTURES / fixture)) == []
+
+
+@pytest.mark.parametrize(
+    "fixture", ["g014_clean.py", "g015_clean.py", "g016_clean.py"]
+)
+def test_clean_fixture_is_quiet(fixture):
+    path = str(FIXTURES / fixture)
+    assert analyze_paths([path]) == []
+    assert lint_file(path) == []
+
+
+def test_g015_flags_restore_onto_old_mesh_across_boundary():
+    """ISSUE acceptance (a): the PR-6 restore-onto-the-old-mesh placement,
+    minimized with the spec obtained THROUGH a helper so G013's local
+    mesh-capture rule is blind — exactly one of G014-G016 must flag it."""
+    findings = analyze_paths([str(FIXTURES / "g015_violation.py")])
+    stale = [f for f in findings if "STALE" in f.message]
+    assert stale, findings
+    assert stale[0].symbol.endswith("Engine.resume")
+    assert codes(findings) == {"G015"}
+
+
+def test_g015_flags_lowering_vs_dispatch_mismatch():
+    """ISSUE acceptance (b): the fused-AOT lowering-spec vs dispatch-seed
+    placement mismatch — the dispatch placement's spec identity is not in
+    the class's registered lowering set."""
+    findings = analyze_paths([str(FIXTURES / "g015_violation.py")])
+    mism = [f for f in findings if "registered" in f.message]
+    assert mism, findings
+    assert mism[0].symbol.endswith("Engine")
+
+
+# ----------------------------------------------------------- MeshModel units
+
+
+def test_axis_universe_resolves_constants_and_param_defaults():
+    src = (
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        'DATA_AXIS = "data"\n'
+        "def data_mesh(devices, axis=DATA_AXIS):\n"
+        "    return Mesh(np.array(devices), (axis,))\n"
+        "def build(devices):\n"
+        "    return data_mesh(devices)\n"
+    )
+    model = model_of(src)
+    assert model.axis_universe == {"data"}
+    # the helper's defaulted axis resolves through the constant table
+    assert model.helper_axis_default["data_mesh"] == "data"
+
+
+def test_unknown_collective_axis_fires_and_known_is_quiet():
+    base = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "def combine(tree):\n"
+        '    return jax.lax.psum(tree, "{axis}")\n'
+    )
+    bad = analyze_source(base.format(axis="dat"))
+    assert codes(bad) == {"G014"}, bad
+    assert analyze_source(base.format(axis="data")) == []
+
+
+def test_one_finding_per_typoed_spec():
+    """The same bad construction surfaces through bind.spec, its CallFact,
+    the nested P call, and spec_args — exactly ONE finding must emerge."""
+    src = (
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "def build(mesh):\n"
+        '    s = NamedSharding(mesh, P("dat"))\n'
+        "    return s\n"
+    )
+    findings = analyze_source(src)
+    assert [f.code for f in findings] == ["G014"], findings
+
+
+def test_incomplete_axis_universe_stays_quiet():
+    """A mesh construction with dynamic (unresolvable) axes marks the
+    universe incomplete: membership checks must not guess — the dropped
+    mesh may define any axis (the errs-quiet contract)."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "def make_dyn(devices, names):\n"
+        "    return Mesh(np.array(devices), names)\n"
+        "def combine(tree):\n"
+        '    return jax.lax.psum(tree, "model")\n'
+    )
+    assert analyze_source(src) == []
+
+
+def test_mesh_param_lattice_joins_over_call_sites():
+    """A mesh-typed parameter's axes are the union of every mesh its
+    resolved callers pass — the mesh-environment lattice join."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def use(mesh):\n"
+        "    return mesh\n"
+        "def a(devices):\n"
+        '    m = Mesh(np.array(devices), ("data",))\n'
+        "    return use(m)\n"
+        "def b(devices):\n"
+        '    m = Mesh(np.array(devices), ("data", "model"))\n'
+        "    return use(m)\n"
+    )
+    model = model_of(src)
+    assert model.param_mesh_axes[("m::use", "mesh")] == {"data", "model"}
+
+
+def test_mesh_returns_resolve_through_wrapper_chains():
+    """``get()`` forwarding ``make()``'s mesh must still supply axes to the
+    shard_map check — the fixpoint chases call edges, not just direct
+    constructions."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "def get(devices):\n"
+        "    m = make(devices)\n"
+        "    return m\n"
+        "def body(x):\n"
+        '    return jax.lax.psum(x, "model")\n'
+        "def wire(devices):\n"
+        "    mesh = get(devices)\n"
+        "    return jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)\n"
+    )
+    model = model_of(src)
+    assert model.mesh_returns["m::get"] == frozenset({"data"})
+    findings = analyze_source(src)
+    assert any("shard_map" in f.message for f in findings), findings
+
+
+def test_mesh_resolution_stops_at_the_use_site():
+    """A mesh rebind AFTER a shard_map must not shadow the mesh the call
+    actually received — local resolution is bounded by the use line."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def body(x):\n"
+        '    return jax.lax.psum(x, "model")\n'
+        "def wire(devices, sub):\n"
+        '    mesh = Mesh(np.array(devices), ("data", "model"))\n'
+        "    out = jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)\n"
+        '    mesh = Mesh(np.array(sub), ("data",))\n'
+        "    return out, mesh\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_g015_helper_obtained_registration_specs_count():
+    """Registration symmetry: a spec lowered under a spec-returning helper
+    (the sds/win_spec idiom) is registered — dispatching under the same
+    helper's spec must not flag."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "class Engine:\n"
+        "    def _sh(self):\n"
+        '        return NamedSharding(self.mesh, P("data"))\n'
+        "    def _submit_aot(self, state):\n"
+        "        seed_t = jax.ShapeDtypeStruct(\n"
+        "            (), jnp.int32, sharding=NamedSharding(self.mesh, P()))\n"
+        "        win = self._sh()\n"
+        "        win_t = jax.ShapeDtypeStruct((4,), jnp.int32, sharding=win)\n"
+        '        self._aot.submit(("fused", 0), state, (seed_t, win_t))\n'
+        "    def _dispatch(self, x):\n"
+        "        sp = self._sh()\n"
+        "        return jax.device_put(x, sp)\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_required_axes_propagate_bottom_up():
+    src = (
+        "import jax\n"
+        "def leaf(x):\n"
+        '    return jax.lax.psum(x, "data")\n'
+        "def mid(x):\n"
+        "    return leaf(x)\n"
+        "def top(x):\n"
+        "    return mid(x)\n"
+    )
+    model = model_of(src)
+    assert model.required_axes["m::top"] == {"data"}
+
+
+def test_shard_map_over_partial_wrapped_target():
+    """The repo idiom: shard_map(functools.partial(fn, ...), mesh=...) —
+    the partial's bound callable is the demand side."""
+    src = (
+        "import jax\n"
+        "import functools\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def body(x, causal=True):\n"
+        '    return jax.lax.psum(x, "model")\n'
+        "def wire(devices):\n"
+        '    mesh = Mesh(np.array(devices), ("data", "model"))\n'
+        '    small = Mesh(np.array(devices), ("data",))\n'
+        "    return jax.shard_map(\n"
+        "        functools.partial(body, causal=False),\n"
+        "        mesh=small, in_specs=None, out_specs=None)\n"
+    )
+    findings = analyze_source(src)
+    assert any(
+        f.code == "G014" and "shard_map" in f.message for f in findings
+    ), findings
+
+
+def test_elastic_reshard_axis_rebind_unit():
+    """The elastic contract: _reshard_world rebuilds the mesh from RUNTIME
+    state. Sizing a placed vector from self.world_size (which the re-shard
+    rebinds) is clean; sizing it from cfg.world_size fires."""
+    base = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "class Engine:\n"
+        "    def __init__(self, cfg, devices):\n"
+        "        self.cfg = cfg\n"
+        "        self.world_size = cfg.world_size\n"
+        '        self.mesh = Mesh(np.array(devices), ("data",))\n'
+        "    def _reshard_world(self, active):\n"
+        "        self.world_size = len(active)\n"
+        '        self.mesh = Mesh(np.array(active), ("data",))\n'
+        "    def stage(self):\n"
+        "        slow = np.zeros({size}, np.int32)\n"
+        "        return jax.device_put(slow, NamedSharding(self.mesh, P()))\n"
+    )
+    clean = base.format(size="self.world_size")
+    assert analyze_source(clean) == [], analyze_source(clean)
+    dirty = base.format(size="self.cfg.world_size")
+    findings = analyze_source(dirty)
+    assert any(
+        f.code == "G014" and "world_size" in f.message for f in findings
+    ), findings
+
+
+def test_world_size_gated_placement_is_not_a_sizing():
+    """Gating a placement on cfg.world_size is not SIZING by it — the sink
+    only fires when its own arguments carry the cfg-sized value."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "class Engine:\n"
+        "    def __init__(self, cfg, devices):\n"
+        "        self.cfg = cfg\n"
+        '        self.mesh = Mesh(np.array(devices), ("data",))\n'
+        "    def _reshard_world(self, active):\n"
+        '        self.mesh = Mesh(np.array(active), ("data",))\n'
+        "    def place(self, x):\n"
+        "        sh = NamedSharding(self.mesh, P())\n"
+        "        return jax.device_put(x, sh) if self.cfg.world_size > 1 else x\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_spec_returns_cross_function_resolution():
+    src = (
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "class E:\n"
+        "    def _sh(self):\n"
+        '        return NamedSharding(self.mesh, P("data"))\n'
+        "    def _sh2(self):\n"
+        "        s = self._sh()\n"
+        "        return s\n"
+    )
+    model = model_of(src)
+    assert model.spec_returns["m::E._sh"] == (("sharding", ("data",)), True)
+    assert model.spec_returns["m::E._sh2"] == (("sharding", ("data",)), True)
+
+
+def test_g015_gen_keyed_placement_is_sanctioned():
+    """A placement whose statement carries the _aot_gen generation marker
+    is sanctioned — the same model G013 uses (stale keys can never hit)."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "class Engine:\n"
+        "    def _sh(self):\n"
+        "        return NamedSharding(self.mesh, P())\n"
+        "    def _reshard_world(self, active):\n"
+        '        self.mesh = Mesh(np.array(active), ("data",))\n'
+        "        self._aot_gen += 1\n"
+        "    def resume(self, ckpt, active):\n"
+        "        sh = self._sh()\n"
+        "        self._reshard_world(active)\n"
+        "        return jax.device_put(ckpt.state, sh), self._aot_gen\n"
+    )
+    assert analyze_source(src) == []
+    # and without the marker it fires
+    bare = src.replace(", self._aot_gen\n", "\n")
+    assert codes(analyze_source(bare)) == {"G015"}
+
+
+def test_g016_cleanse_through_quantize_markers():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "def epoch(shares, global_batch, bucket):\n"
+        "    batches = integer_batch_split(shares, global_batch)\n"
+        "    snapped = quantize_batches(batches, bucket, global_batch)\n"
+        '    return jax.lax.all_gather(snapped, "data")\n'
+    )
+    assert analyze_source(src) == []
+    raw = src.replace(
+        "snapped = quantize_batches(batches, bucket, global_batch)",
+        "snapped = batches",
+    )
+    assert codes(analyze_source(raw)) == {"G016"}
+
+
+def test_g016_interprocedural_param_sink():
+    """The taint and the collective live in different functions: the
+    finding lands at the CALL site handing the raw plan widths over."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "def gather_all(vec):\n"
+        '    return jax.lax.all_gather(vec, "data")\n'
+        "def epoch(shares, global_batch):\n"
+        "    batches = integer_batch_split(shares, global_batch)\n"
+        "    return gather_all(batches)\n"
+    )
+    findings = analyze_source(src)
+    assert [f.code for f in findings] == ["G016"], findings
+    assert findings[0].line == 10
+
+
+def test_g016_taint_climbs_multi_level_call_chains():
+    """A param handed straight into a callee's sink position keeps the
+    chain climbing: top -> mid -> helper -> all_gather still flags top."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "def helper(x):\n"
+        '    return jax.lax.all_gather(x, "data")\n'
+        "def mid(v):\n"
+        "    return helper(v)\n"
+        "def top(shares, global_batch):\n"
+        "    batches = integer_batch_split(shares, global_batch)\n"
+        "    return mid(batches)\n"
+    )
+    findings = analyze_source(src)
+    assert [f.code for f in findings] == ["G016"], findings
+    assert findings[0].line == 12
+
+
+def test_inline_suppression_silences_mesh_findings():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "def combine(tree):\n"
+        '    return jax.lax.psum(tree, "dcn")  # graftlint: disable=G014\n'
+    )
+    assert analyze_source(src) == []
+
+
+# ------------------------------------------------- runtime budget (tier-1)
+
+
+def test_mesh_self_runtime_budget(tmp_path):
+    """ISSUE acceptance: the full-repo --flow run including G014-G016 must
+    stay within 2x of graftflow's budget (cold) and the cached warm run
+    decisively under it. Bounds mirror tests/test_graftflow.py."""
+    cache = str(tmp_path / "cache")
+    t0 = time.perf_counter()
+    cold = lint_paths(
+        [str(PKG), str(REPO / "bench.py")], jobs=0, cache_dir=cache, flow=True
+    )
+    cold_s = time.perf_counter() - t0
+    assert cold_s < 120.0, f"cold full-repo --flow took {cold_s:.1f}s"
+    t0 = time.perf_counter()
+    warm = lint_paths(
+        [str(PKG), str(REPO / "bench.py")], jobs=0, cache_dir=cache, flow=True
+    )
+    warm_s = time.perf_counter() - t0
+    assert warm_s < 60.0, f"warm full-repo --flow took {warm_s:.1f}s"
+    key = lambda fs: [(f.code, f.path, f.line, f.message) for f in fs]
+    assert key(cold) == key(warm)

@@ -3,11 +3,14 @@
 Any future PR that reintroduces a G00x violation in the package or bench.py
 fails the default fast pytest run right here — the CI half of the ISSUE-1
 contract (`graftlint dynamic_load_balance_distributeddnn_tpu bench.py`
-exits 0). Since ISSUE 8 the gate also runs the whole-program rules
-(`--flow`: G011 donation lifetimes, G012 thread/lock discipline, G013
-stale-mesh placement) with NO baseline file: every pre-existing finding was
-either fixed or carries an inline `# graftlint: disable=G01x` with a
-justification comment, so new interprocedural regressions fail here too.
+exits 0). Since ISSUE 8 the gate also runs the whole-program rules with NO
+baseline file (`--flow`: G011 donation lifetimes, G012 thread/lock
+discipline, G013 stale-mesh placement, and since ISSUE 10 the graftmesh
+families — G014 collective/axis consistency, G015 sharding-spec flow, G016
+non-uniform shard arithmetic): every pre-existing finding was either fixed
+or carries an inline `# graftlint: disable=G01x` with a justification
+comment, so new interprocedural regressions fail here too.
+`scripts/lint_sarif.sh` is the same pass wired for per-line CI annotation.
 """
 
 import pathlib

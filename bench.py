@@ -48,6 +48,12 @@ init can block 50+ minutes and then fail UNAVAILABLE):
    plus the post-recovery steady epoch wall vs a fresh run started at the
    reduced world size (`elastic_recovery_ab` field; ISSUE 6,
    BENCH_ELASTIC_AB=0 disables).
+9. ONLINE DBS A/B — the CPU tier runs the SAME time-varying compute-mode
+   straggler (sin schedule over a 5:1 profile) under window-cadence
+   rebalancing (the hysteresis controller switches plans mid-epoch) vs the
+   reference epoch cadence (`online_dbs_ab` field: steady epoch walls,
+   switch counts, controller ledger, realized injection; ISSUE 11,
+   BENCH_ONLINE_AB=0 disables, BENCH_ONLINE_SCHEDULE/PERIOD/EPOCHS tune).
 
 Instrumentation: examples/s and MFU (obs/flops.py, XLA cost model vs chip
 bf16 peak) from the trainer's recorder extras, reported in `detail`.
@@ -720,6 +726,102 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
                 )
                 sys.stderr.write(f"[bench] elastic_recovery_ab: {ab['error']}\n")
             out["instr"]["elastic_recovery_ab"] = ab
+        _write_atomic(out_path, out)
+
+    if (
+        force_cpu
+        and os.environ.get("BENCH_ONLINE_AB", "1") == "1"
+        and "online_dbs_ab" not in out["instr"]
+    ):
+        if resume.get("instr", {}).get("online_dbs_ab"):
+            out["instr"]["online_dbs_ab"] = resume["instr"]["online_dbs_ab"]
+        else:
+            # Online-DBS cadence A/B (ISSUE 11 acceptance): the SAME
+            # time-varying compute-mode injection (sin schedule over a 5:1
+            # straggler, period spanning epochs so the flanks cross epoch
+            # boundaries) balanced at window cadence (--rebalance window:
+            # the hysteresis controller switches plans MID-epoch) vs the
+            # reference epoch cadence. The CONTENTION topology (all workers
+            # one device, the reference's -gpu 0,0,0,0) makes the
+            # controller's summed step-time model physically exact on this
+            # serialized tier; per-step dispatch (superstep off) keeps the
+            # whole bucket-8 rung ladder warm so NO plan — boundary or
+            # mid-epoch — ever compiles inside a wall. Metric: MEAN wall
+            # over the injected epochs (a min would erase exactly the
+            # stale-plan transients the time-varying scenario exists to
+            # measure); both arms run the identical deterministic schedule,
+            # so the delta is the cadence.
+            from dynamic_load_balance_distributeddnn_tpu.faults import (
+                ScheduledStragglerInjector,
+            )
+
+            sched = os.environ.get("BENCH_ONLINE_SCHEDULE", "sin")
+            period = float(os.environ.get("BENCH_ONLINE_PERIOD", 3.0))
+            n_ep = max(int(os.environ.get("BENCH_ONLINE_EPOCHS", 7)), 4)
+            online_factors = [5.0] + [1.0] * (ws - 1)
+            ab = {
+                "schedule": sched,
+                "period_epochs": period,
+                "nominal_injection_profile": online_factors,
+            }
+            for label, cadence in (("window", "window"), ("epoch", "epoch")):
+                cfg = Config(
+                    debug=False,
+                    world_size=ws,
+                    batch_size=128,
+                    learning_rate=0.01,
+                    epoch_size=n_ep,
+                    dataset=dataset,
+                    model=model,
+                    dynamic_batch_size=True,
+                    fault_tolerance=False,
+                    fault_mode="compute",
+                    bucket=8,
+                    precision=precision,
+                    warm_start=True,
+                    stream_chunk_steps=2,
+                    device=0,
+                    packed="off",
+                    superstep="off",
+                    rebalance=cadence,
+                )
+                tr = Trainer(
+                    cfg,
+                    bundle=bundle,
+                    injector=ScheduledStragglerInjector(
+                        online_factors, mode="compute", schedule=sched,
+                        period=period,
+                    ),
+                    log_to_file=False,
+                )
+                walls = [round(tr.run_epoch(e)["epoch_wall"], 4) for e in range(n_ep)]
+                ab[f"{label}_walls_s"] = walls
+                # epoch 0 calibrates injection-free; the injected epochs
+                # 1..N-1 are the scenario — MEAN, not min (see above)
+                ab[f"{label}_wall_s"] = round(
+                    sum(walls[1:]) / max(len(walls) - 1, 1), 4
+                )
+                ab[f"{label}_injection_calibrated"] = bool(
+                    getattr(tr, "_iter_cost_calibrated", False)
+                )
+                if tr.recorder.meta.get("realized_injection_profile") is not None:
+                    ab[f"{label}_realized_injection_profile"] = tr.recorder.meta[
+                        "realized_injection_profile"
+                    ]
+                if cadence == "window":
+                    sw = tr.recorder.data.get("plan_switches") or []
+                    ab["switches_per_epoch"] = [int(v) for v in sw]
+                    ab["switch_count"] = int(sum(sw))
+                    if tr._rebalance_ctl is not None:
+                        ab["controller"] = tr._rebalance_ctl.snapshot()
+                    ab["rebalance_events"] = tr.recorder.meta.get(
+                        "rebalance_events", []
+                    )
+            if ab.get("window_wall_s") and ab.get("epoch_wall_s"):
+                ab["speedup_x"] = round(
+                    ab["epoch_wall_s"] / ab["window_wall_s"], 3
+                )
+            out["instr"]["online_dbs_ab"] = ab
         _write_atomic(out_path, out)
     return 0
 

@@ -317,6 +317,10 @@ class BindFact:
     rhs_is_copy: bool  # RHS is a forced-copy spelling (breaks aliases)
     donate_argnums: Tuple[int, ...] = ()  # RHS is jit(..., donate_argnums=...)
     spec: Optional["SpecCtor"] = None  # RHS is a mesh/sharding construction
+    # container tokens among ``targets`` that were SUBSCRIPT stores
+    # (``d[k] = v`` -> "d"): element mutation, not a rebind — taint unions
+    # into the container instead of replacing it (G016)
+    sub_targets: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -483,10 +487,16 @@ def _alias_sources(node: ast.expr) -> List[str]:
     return out
 
 
-def _dotted_targets(stmt: ast.stmt) -> List[str]:
-    """Plain + dotted assignment targets (``x``, ``self.state``); subscripted
-    targets contribute their container token (``extras["k"] = v`` -> extras)."""
+def _dotted_targets(stmt: ast.stmt) -> "Tuple[List[str], List[str]]":
+    """``(targets, sub_targets)``: plain + dotted assignment targets (``x``,
+    ``self.state``), with subscripted targets contributing their container
+    token (``extras["k"] = v`` -> extras) — those container tokens are ALSO
+    listed in ``sub_targets``, because a subscript store MUTATES an element
+    of an existing value rather than rebinding the name (taint rules must
+    union into, never replace, the container's taint — G016's
+    container-element channel)."""
     out: List[str] = []
+    subs: List[str] = []
 
     def collect(t: ast.expr) -> None:
         base = t
@@ -495,6 +505,8 @@ def _dotted_targets(stmt: ast.stmt) -> List[str]:
         tok = dotted_name(base)
         if tok is not None:
             out.append(tok)
+            if base is not t:
+                subs.append(tok)
         elif isinstance(t, (ast.Tuple, ast.List)):
             for e in t.elts:
                 collect(e)
@@ -512,7 +524,7 @@ def _dotted_targets(stmt: ast.stmt) -> List[str]:
         for item in stmt.items:
             if item.optional_vars is not None:
                 collect(item.optional_vars)
-    return out
+    return out, subs
 
 
 class _FunctionLowerer:
@@ -759,7 +771,7 @@ class _FunctionLowerer:
         return out
 
     def _bind_fact(self, stmt: ast.stmt) -> Optional[BindFact]:
-        targets = _dotted_targets(stmt)
+        targets, sub_targets = _dotted_targets(stmt)
         if not targets:
             return None
         value: Optional[ast.expr] = None
@@ -775,6 +787,7 @@ class _FunctionLowerer:
                 rhs_call_name="",
                 alias_sources=(),
                 rhs_is_copy=False,
+                sub_targets=tuple(sub_targets),
             )
         rhs_call_name = ""
         donate: Tuple[int, ...] = ()
@@ -794,6 +807,7 @@ class _FunctionLowerer:
             rhs_is_copy=_is_copy_expr(value),
             donate_argnums=donate,
             spec=spec,
+            sub_targets=tuple(sub_targets),
         )
 
     def _ret_fact(self, stmt: ast.Return) -> RetFact:

@@ -138,6 +138,13 @@ FIXED_SHAPE_COLLECTIVES = {
 _DEVICE_CONCAT_TAILS = {"concatenate", "stack", "hstack", "vstack"}
 _DEVICE_NS = ("jnp.", "jax.numpy.")
 _LOCAL_ORIGIN = "<plan>"
+# container-mutation spellings that store a value INTO an existing
+# container: plan taint flows into the receiver (G016's container-element
+# channel — `cols.append(batches)` then `jnp.stack(cols)` is the same bug
+# as stacking `batches` directly)
+_CONTAINER_MUTATORS = {
+    "append", "add", "extend", "insert", "appendleft", "setdefault",
+}
 
 
 def reshard_surface(
@@ -1127,15 +1134,20 @@ class RuleG016:
         graph = ctx.graph
 
         # per-function transfer facts: which params reach a sink, whether
-        # the return carries plan taint, and the local findings
+        # the return carries plan taint, the per-CLASS tainted self-attrs
+        # (plan-derived values stored on `self` in one method and read in
+        # another — the PR-10 modeling gap the window controller's
+        # plan-on-self state made urgent), and the local findings
         sink_params: Dict[str, Set[int]] = {}
         tainted_returns: Set[str] = set()
+        attr_taint: Dict[Tuple[str, str], Set[str]] = {}
         local_sites: Dict[str, List[Tuple[CallFact, str]]] = {}
         for _ in range(6):
             changed = False
             for fqn, fn in ctx.project.functions.items():
-                sp, tr, sites = self._flow_function(
-                    model, graph, fn, cleanse, sink_params, tainted_returns
+                sp, tr, sites, new_attrs = self._flow_function(
+                    model, graph, fn, cleanse, sink_params, tainted_returns,
+                    attr_taint,
                 )
                 if sp != sink_params.get(fqn, set()):
                     sink_params[fqn] = sp
@@ -1143,6 +1155,11 @@ class RuleG016:
                 if tr and fqn not in tainted_returns:
                     tainted_returns.add(fqn)
                     changed = True
+                if fn.cls and new_attrs:
+                    cur = attr_taint.setdefault((fn.module, fn.cls), set())
+                    if not new_attrs <= cur:
+                        cur |= new_attrs
+                        changed = True
                 local_sites[fqn] = sites
             if not changed:
                 break
@@ -1174,16 +1191,35 @@ class RuleG016:
         cleanse: Set[str],
         sink_params: Dict[str, Set[int]],
         tainted_returns: Set[str],
-    ) -> Tuple[Set[int], bool, List[Tuple[CallFact, str]]]:
+        attr_taint: Dict[Tuple[str, str], Set[str]],
+    ) -> Tuple[Set[int], bool, List[Tuple[CallFact, str]], Set[str]]:
         fqn = Project.fqn(fn)
         edge_by_call = {id(e.call): e for e in graph.edges.get(fqn, ())}
         edge_by_line = model.edges_by_line(fqn)
         param_origin = {p: frozenset({p}) for p in fn.params}
-        taint: Dict[str, FrozenSet[str]] = {}
+        # self-attr taint: attrs of THIS class whose writes carry plan taint
+        # (any method, prior fixpoint rounds) seed the bare attr-component
+        # identifier — identifiers_in lowers `self._sizes` to {"self",
+        # "_sizes"}, so reads flow through the same ident machinery as
+        # locals. Coarse on shadowing locals, which matches the rest of the
+        # ident-level model.
+        cls_attrs = (
+            attr_taint.get((fn.module, fn.cls), set()) if fn.cls else set()
+        )
+        taint: Dict[str, FrozenSet[str]] = {
+            a: frozenset({_LOCAL_ORIGIN}) for a in cls_attrs
+        }
+        new_attrs: Set[str] = set()
         hit_params: Set[int] = set()
         local_hits: List[Tuple[CallFact, str]] = []
         ret_tainted = False
         param_index = {p: i for i, p in enumerate(fn.params)}
+
+        def self_attr_of(token: str) -> Optional[str]:
+            parts = token.split(".")
+            if len(parts) >= 2 and parts[0] == "self":
+                return parts[1]
+            return None
 
         def origins_of(idents: FrozenSet[str]) -> FrozenSet[str]:
             out: Set[str] = set()
@@ -1234,13 +1270,36 @@ class RuleG016:
                         # sink position: the chain must keep climbing
                         for name in idents & set(param_index):
                             hit_params.add(param_index[name])
+                # container-element channel: a mutator stores a tainted
+                # value INTO an existing container — taint the receiver
+                # (self-attr receivers additionally feed the class fixpoint)
+                if (
+                    call.tail in _CONTAINER_MUTATORS
+                    and call.name
+                    and "." in call.name
+                ):
+                    all_ids: Set[str] = set()
+                    for ids in call.arg_idents:
+                        all_ids |= ids
+                    m_orgs = origins_of(frozenset(all_ids))
+                    if m_orgs and not (all_ids & cleanse):
+                        recv = call.name.rsplit(".", 1)[0]
+                        attr = self_attr_of(recv)
+                        key = attr if attr is not None else recv.split(".")[0]
+                        taint[key] = taint.get(key, frozenset()) | m_orgs
+                        if attr is not None and _LOCAL_ORIGIN in m_orgs:
+                            new_attrs.add(attr)
             bind = stmt.bind
             if bind is None:
                 continue
             idents = bind.rhs_idents
+            subs = set(bind.sub_targets)
             if idents & cleanse:
                 for tgt in bind.targets:
-                    taint.pop(tgt, None)
+                    if tgt not in subs:
+                        # an element store never un-taints its container —
+                        # only a rebind of the whole name cleanses
+                        taint.pop(tgt, None)
                 continue
             orgs: Set[str] = set(origins_of(idents))
             if bind.rhs_call_tail in UNEQUAL_SOURCE_TAILS:
@@ -1255,8 +1314,27 @@ class RuleG016:
                 if base in param_origin:
                     orgs |= param_origin[base]
             for tgt in bind.targets:
+                attr = self_attr_of(tgt)
+                if tgt in subs:
+                    # subscript store: element mutation unions into the
+                    # container's taint (and never pops it)
+                    if orgs:
+                        taint[tgt] = taint.get(tgt, frozenset()) | orgs
+                        if attr is not None:
+                            taint[attr] = taint.get(attr, frozenset()) | orgs
+                            if _LOCAL_ORIGIN in orgs:
+                                new_attrs.add(attr)
+                    continue
                 if orgs:
                     taint[tgt] = frozenset(orgs)
+                    if attr is not None:
+                        # self-attr write: flows to every method of the
+                        # class through the attr_taint fixpoint; seeding the
+                        # bare component here makes same-pass local reads
+                        # see it too
+                        taint[attr] = frozenset(orgs)
+                        if _LOCAL_ORIGIN in orgs:
+                            new_attrs.add(attr)
                 else:
                     taint.pop(tgt, None)
         for stmt in fn.stmts:
@@ -1265,7 +1343,7 @@ class RuleG016:
             for tok in stmt.ret.alias_tokens:
                 if _LOCAL_ORIGIN in taint.get(tok, frozenset()):
                     ret_tainted = True
-        return hit_params, ret_tainted, local_hits
+        return hit_params, ret_tainted, local_hits, new_attrs
 
     @staticmethod
     def _is_sink(call: CallFact) -> bool:

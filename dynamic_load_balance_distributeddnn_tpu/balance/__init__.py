@@ -1,4 +1,9 @@
+from dynamic_load_balance_distributeddnn_tpu.balance.controller import (
+    OnlineRebalanceController,
+    SwitchDecision,
+)
 from dynamic_load_balance_distributeddnn_tpu.balance.solver import (
+    equilibrium_shares,
     initial_partition,
     integer_batch_split,
     rebalance,
@@ -11,6 +16,9 @@ from dynamic_load_balance_distributeddnn_tpu.balance.timing import (
 )
 
 __all__ = [
+    "OnlineRebalanceController",
+    "SwitchDecision",
+    "equilibrium_shares",
     "initial_partition",
     "integer_batch_split",
     "rebalance",

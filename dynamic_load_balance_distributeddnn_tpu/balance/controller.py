@@ -1,0 +1,295 @@
+"""Online DBS: the window-cadence rebalance controller (ISSUE 11).
+
+The reference (and this engine's epoch loop) re-solves the inverse-time
+partition once per EPOCH, so a straggler that appears mid-epoch is paid for
+until the next boundary — the time-varying scenario (``sin``/ramp injection
+schedules, faults.py ScheduledStragglerInjector) the epoch cadence cannot
+touch. With supersteps (one dispatch per window), compile-horizon-zero and
+solver-trajectory speculation already shipped, a mid-epoch plan change is
+nearly free — what was missing is the DECISION machinery: when is a switch
+worth its cost?
+
+This controller answers at window cadence, in the style of *Online Dynamic
+Batching with Formal Guarantees for LLM Training* (PAPERS.md): a regret-style
+account where the cost of acting (switching plans) is only ever paid when the
+predicted remaining-horizon win covers it with margin, and cumulative switch
+spend is budgeted against cumulative banked wins so the plan cannot thrash
+even under an adversarial signal.
+
+Signal path (engine -> controller):
+
+* **EMA per-worker rates** — seconds/example per worker, seeded from the
+  engine's probe anchors (``per_example_cost``) or last node-time vector and
+  folded with ``observe_rates`` each evaluation;
+* **instantaneous fault multipliers** — the injector's ``faults_at`` view of
+  the schedule at the next window's midpoint (the engine composes them into
+  the effective rates it hands ``propose``);
+* **measured step-wall feedback** — the realized wall of the windows since
+  the last evaluation vs the model's prediction, folded in as a bounded
+  multiplicative scale (``observe_wall``), so genuine un-modeled speed
+  changes move the ABSOLUTE win estimate (and therefore the hysteresis
+  decision) without disturbing the relative allocation.
+
+Decision rule (hysteresis + regret budget):
+
+    switch  iff  candidate != current plan
+            and  win >= hysteresis * predicted remaining time   (relative)
+            and  win >= margin * switch_cost                    (absolute)
+            and  spent + switch_cost <= budget_frac * (credit + win)
+
+where ``win = (step_time(current) - step_time(candidate)) * remaining_steps``
+under the per-device step-time model (max over devices of the summed worker
+times on that device), ``switch_cost`` is the EMA of MEASURED switch costs
+(seeded by ``cost_init``), and (spent, credit) are the cumulative cost/win
+ledgers. Every quantity is host-side numpy; the controller never touches jax.
+
+The engine additionally warm-gates: a switch whose candidate executables are
+not yet AOT-compiled is DEFERRED (``note_deferred``), so a switch never pays
+a foreground XLA compile — the zero-foreground-compile sentinel contract
+(tests/test_online_dbs.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dynamic_load_balance_distributeddnn_tpu.balance.solver import (
+    quantize_batches,
+    rebalance,
+)
+
+
+@dataclasses.dataclass
+class SwitchDecision:
+    """One evaluation's outcome. ``switch`` is the controller's verdict; the
+    engine may still defer (cold executables) via ``note_deferred``."""
+
+    switch: bool
+    reason: str
+    candidate_batches: Optional[np.ndarray] = None
+    candidate_shares: Optional[np.ndarray] = None
+    predicted_win_s: float = 0.0
+    cur_step_s: float = 0.0
+    new_step_s: float = 0.0
+    cost_est_s: float = 0.0
+    remaining_steps: int = 0
+
+
+def step_time(
+    rates: np.ndarray, batches: np.ndarray, groups: Sequence[Sequence[int]]
+) -> float:
+    """Modeled per-step wall under a batch split: workers sharing a device
+    serialize (sum), devices run in parallel (max) — the elastic dispatch
+    topology's cost model."""
+    r = np.asarray(rates, dtype=np.float64)
+    b = np.asarray(batches, dtype=np.float64)
+    per_worker = r * b
+    return float(max(sum(per_worker[w] for w in g) for g in groups if len(g)))
+
+
+class OnlineRebalanceController:
+    """Window-cadence hysteresis controller over the inverse-time solver."""
+
+    def __init__(
+        self,
+        world_size: int,
+        global_batch: int,
+        groups: Sequence[Sequence[int]],
+        *,
+        bucket: int = 0,
+        max_share: Optional[float] = None,
+        hysteresis: float = 0.1,
+        margin: float = 3.0,
+        budget_frac: float = 0.5,
+        rate_alpha: float = 0.5,
+        cost_init: float = 0.01,
+        logger=None,
+    ):
+        if not 0.0 < rate_alpha <= 1.0:
+            raise ValueError("rate_alpha must be in (0, 1]")
+        if hysteresis < 0.0 or margin < 0.0 or budget_frac <= 0.0:
+            raise ValueError("hysteresis/margin must be >= 0, budget_frac > 0")
+        self.world_size = int(world_size)
+        self.global_batch = int(global_batch)
+        self.groups = [list(g) for g in groups if len(g)]
+        self.bucket = int(bucket)
+        self.max_share = max_share
+        self.hysteresis = float(hysteresis)
+        self.margin = float(margin)
+        self.budget_frac = float(budget_frac)
+        self.rate_alpha = float(rate_alpha)
+        self.cost_init = float(cost_init)
+        self.logger = logger
+        # EMA state
+        self.rates: Optional[np.ndarray] = None  # seconds/example per worker
+        self.wall_scale = 1.0  # bounded measured/modeled wall feedback
+        self.switch_cost_s: Optional[float] = None  # EMA of measured costs
+        # ledgers (the regret-style account)
+        self.spent_s = 0.0  # switch cost actually paid
+        self.credit_s = 0.0  # predicted wins banked at executed switches
+        self.switches = 0
+        self.evals = 0
+        self.deferred = 0  # engine vetoes (candidate executables cold)
+        self.last_candidate_batches: Optional[np.ndarray] = None
+        self.events: List[Dict] = []
+        self.on_switch = None  # test/observability hook: fn(event_dict)
+
+    # ------------------------------------------------------------- signal
+
+    def observe_rates(self, rates: np.ndarray) -> None:
+        """Fold a fresh per-worker per-example rate estimate into the EMA
+        (``rate_alpha`` weights the newest sample). A world-size change
+        restarts the track — stale per-worker identities mean nothing."""
+        r = np.asarray(rates, dtype=np.float64)
+        if not np.isfinite(r).all() or (r <= 0).any():
+            return
+        if self.rates is None or self.rates.shape != r.shape:
+            self.rates = r.copy()
+            return
+        scale = float(np.median(r) / max(np.median(self.rates), 1e-300))
+        if not 0.25 <= scale <= 4.0:
+            # a whole-track scale jump is a re-anchoring (fresh probe
+            # baseline, clock regime change), not a gradual drift — folding
+            # it through the EMA would leave the absolute win estimates at
+            # the wrong scale for a half-life of evaluations
+            self.rates = r.copy()
+            return
+        self.rates = self.rate_alpha * r + (1.0 - self.rate_alpha) * self.rates
+
+    def observe_wall(self, measured_s: float, modeled_s: float) -> None:
+        """Step-wall feedback: the measured wall of the windows since the
+        last evaluation vs the model's prediction for the same windows. The
+        bounded ratio scales the ABSOLUTE win estimate (a uniformly slow or
+        fast host moves every worker the same way — the relative allocation
+        stays with the rates); the clip keeps one outlier wall from swinging
+        the hysteresis decision."""
+        if modeled_s <= 0 or measured_s <= 0 or not np.isfinite(measured_s):
+            return
+        scale = float(np.clip(measured_s / modeled_s, 0.25, 4.0))
+        self.wall_scale = 0.5 * scale + 0.5 * self.wall_scale
+
+    # ----------------------------------------------------------- decision
+
+    def cost_estimate(self) -> float:
+        return self.switch_cost_s if self.switch_cost_s is not None else self.cost_init
+
+    def propose(
+        self,
+        eff_rates: np.ndarray,
+        cur_batches: np.ndarray,
+        remaining_steps: int,
+    ) -> SwitchDecision:
+        """One evaluation: solve the inverse-time partition on the effective
+        rates and decide whether switching the remaining windows onto it
+        beats the measured switch cost under hysteresis + budget."""
+        self.evals += 1
+        c = np.asarray(eff_rates, dtype=np.float64)
+        b_cur = np.asarray(cur_batches, dtype=np.int64)
+        if remaining_steps <= 0:
+            return SwitchDecision(False, "no-horizon")
+        if not np.isfinite(c).all() or (c <= 0).any():
+            return SwitchDecision(False, "no-signal")
+        cur_shares = b_cur.astype(np.float64) / max(b_cur.sum(), 1)
+        times = c * np.maximum(b_cur, 1)
+        new_shares, batches = rebalance(
+            times, cur_shares, self.global_batch, max_share=self.max_share
+        )
+        if self.bucket > 0:
+            batches = quantize_batches(batches, self.bucket, self.global_batch)
+            new_shares = batches.astype(np.float64) / batches.sum()
+        self.last_candidate_batches = batches.copy()
+        if np.array_equal(batches, b_cur):
+            return SwitchDecision(False, "same-plan", batches, new_shares)
+        cur_step = step_time(c, b_cur, self.groups) * self.wall_scale
+        new_step = step_time(c, batches, self.groups) * self.wall_scale
+        win = (cur_step - new_step) * remaining_steps
+        cost = self.cost_estimate()
+        dec = SwitchDecision(
+            False,
+            "",
+            batches,
+            new_shares,
+            predicted_win_s=win,
+            cur_step_s=cur_step,
+            new_step_s=new_step,
+            cost_est_s=cost,
+            remaining_steps=int(remaining_steps),
+        )
+        if win < self.hysteresis * cur_step * remaining_steps:
+            dec.reason = "below-hysteresis"
+            return dec
+        if win < self.margin * cost:
+            dec.reason = "below-margin"
+            return dec
+        if self.spent_s + cost > self.budget_frac * (self.credit_s + win):
+            dec.reason = "budget-exhausted"
+            return dec
+        dec.switch = True
+        dec.reason = "switch"
+        return dec
+
+    # --------------------------------------------------------- bookkeeping
+
+    def commit(
+        self, dec: SwitchDecision, measured_cost_s: float, **extra
+    ) -> Dict:
+        """The engine EXECUTED the switch: pay the measured cost into the
+        ledger, bank the predicted win, fold the cost EMA, and record the
+        event (engine mirrors it into recorder meta / graftscope)."""
+        self.switches += 1
+        self.spent_s += float(measured_cost_s)
+        self.credit_s += max(float(dec.predicted_win_s), 0.0)
+        prev = self.switch_cost_s
+        self.switch_cost_s = (
+            float(measured_cost_s)
+            if prev is None
+            else 0.5 * float(measured_cost_s) + 0.5 * prev
+        )
+        ev = {
+            "reason": dec.reason,
+            "predicted_win_s": round(float(dec.predicted_win_s), 6),
+            "switch_cost_s": round(float(measured_cost_s), 6),
+            "cur_step_s": round(float(dec.cur_step_s), 6),
+            "new_step_s": round(float(dec.new_step_s), 6),
+            "remaining_steps": int(dec.remaining_steps),
+            "batches": [int(b) for b in dec.candidate_batches],
+            "spent_s": round(self.spent_s, 6),
+            "credit_s": round(self.credit_s, 6),
+        }
+        ev.update(extra)
+        self.events.append(ev)
+        if self.logger is not None:
+            self.logger.info(
+                f"online-dbs: switched plan -> {ev['batches']} "
+                f"(win {ev['predicted_win_s']}s over {ev['remaining_steps']} "
+                f"steps, cost {ev['switch_cost_s']}s)"
+            )
+        if self.on_switch is not None:
+            self.on_switch(ev)
+        return ev
+
+    def note_deferred(self) -> None:
+        """A verdict-positive switch the engine vetoed because the candidate
+        executables were still compiling (warm gating): the hysteresis
+        re-evaluates at the next cadence boundary, by which time the
+        speculative submit issued alongside the verdict has usually landed."""
+        self.deferred += 1
+
+    def snapshot(self) -> Dict:
+        """JSON-safe controller observability (recorder meta / registry)."""
+        return {
+            "evals": self.evals,
+            "switches": self.switches,
+            "deferred": self.deferred,
+            "spent_s": round(self.spent_s, 6),
+            "credit_s": round(self.credit_s, 6),
+            "switch_cost_ema_s": (
+                round(self.switch_cost_s, 6)
+                if self.switch_cost_s is not None
+                else None
+            ),
+            "wall_scale": round(self.wall_scale, 4),
+        }

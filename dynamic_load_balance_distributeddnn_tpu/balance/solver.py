@@ -102,6 +102,22 @@ def quantize_batches(
     return units * int(bucket)
 
 
+def equilibrium_shares(rates: np.ndarray) -> np.ndarray:
+    """The inverse-time fixed point for per-worker per-example RATES
+    (seconds/example): share_i ∝ 1/c_i, the partition at which every
+    worker's step takes the same wall-clock. One step of :func:`rebalance`
+    from any interior point lands here — the engine's probe-seeded
+    readmission uses it to seed a recovered worker's share straight at the
+    equilibrium of its measured cost (the window controller's propose keeps
+    the full :func:`rebalance` round trip instead, because it also needs
+    the capacity cap and integer split)."""
+    c = np.asarray(rates, dtype=np.float64)
+    if np.any(c <= 0) or not np.isfinite(c).all():
+        raise ValueError("rates must be positive and finite")
+    inv = 1.0 / c
+    return inv / inv.sum()
+
+
 class ShareTrajectoryPredictor:
     """One-step-ahead prediction of the solver's share vector.
 

@@ -75,6 +75,9 @@ class HostOverheadMeter:
             self.dispatch_s = 0.0
             self.put_s = 0.0
             self.dispatches = 0
+            self._mark_dispatch_s = 0.0
+            self._mark_put_s = 0.0
+            self._mark_dispatches = 0
 
     @contextmanager
     def dispatch(self):
@@ -90,6 +93,20 @@ class HostOverheadMeter:
     def add_put_s(self, seconds: float) -> None:
         with self._lock:
             self.put_s += float(seconds)
+
+    def mark_window(self) -> "tuple[float, float, int]":
+        """Per-window snapshot: (dispatch_s, put_s, dispatches) accumulated
+        since the previous mark — the host-side component of the window
+        controller's step-wall signal (ISSUE 11). The cumulative epoch
+        totals above are untouched; marks only move the window baseline."""
+        with self._lock:
+            d = self.dispatch_s - getattr(self, "_mark_dispatch_s", 0.0)
+            p = self.put_s - getattr(self, "_mark_put_s", 0.0)
+            n = self.dispatches - getattr(self, "_mark_dispatches", 0)
+            self._mark_dispatch_s = self.dispatch_s
+            self._mark_put_s = self.put_s
+            self._mark_dispatches = self.dispatches
+            return d, p, n
 
     def per_step(self, num_steps: int) -> float:
         """Host overhead (dispatch + put walls) amortized per plan step."""

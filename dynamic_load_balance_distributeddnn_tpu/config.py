@@ -391,6 +391,53 @@ class Config:
     elastic_max_recoveries: int = 8    # recovery attempts before the run
                                        # gives up (a fleet losing workers
                                        # faster than this is not a fleet)
+    rebalance: str = "epoch"           # "epoch"|"window": DBS control-loop
+                                       # cadence (ISSUE 11). epoch: the
+                                       # reference semantics — one inverse-
+                                       # time re-solve per epoch boundary.
+                                       # window: an online hysteresis
+                                       # controller (balance/controller.py)
+                                       # re-evaluates every rebalance_every
+                                       # windows inside the elastic epoch,
+                                       # and retires the remaining windows
+                                       # under a new plan when the predicted
+                                       # remaining-epoch win beats the
+                                       # measured switch cost — the time-
+                                       # varying straggler scenario
+                                       # (sin/ramp schedules) the epoch
+                                       # cadence cannot touch. Elastic
+                                       # dispatch paths only; single-process
+                                       # only (the switch decision folds
+                                       # locally measured walls).
+    rebalance_every: int = 1           # window cadence: evaluate the online
+                                       # controller every K dispatch windows
+    rebalance_hysteresis: float = 0.1  # relative hysteresis: switch only
+                                       # when the predicted win is at least
+                                       # this fraction of the predicted
+                                       # remaining-epoch time
+    rebalance_margin: float = 3.0      # absolute hysteresis: predicted win
+                                       # must exceed margin x the measured
+                                       # (EMA) switch cost
+    rebalance_budget_frac: float = 0.5 # regret-style budget: cumulative
+                                       # switch spend may never exceed this
+                                       # fraction of cumulative banked wins
+                                       # (+ the pending win) — the no-thrash
+                                       # brake when costs drift above
+                                       # estimates. Needs margin >= 1/frac
+                                       # for the first switch to be
+                                       # admissible.
+    rebalance_rate_alpha: float = 0.5  # EMA weight on the newest per-worker
+                                       # rate sample in the controller
+    fault_schedule: str = "none"       # "none"|"sin"|"ramp": time-VARYING
+                                       # straggler schedule over the
+                                       # --straggler factors (faults.py
+                                       # ScheduledStragglerInjector): factors
+                                       # follow the schedule gain within
+                                       # epochs — the scenario the window-
+                                       # cadence controller exists for.
+                                       # none = the static profile.
+    fault_period: float = 2.0          # schedule period in epochs (sin:
+                                       # full cycle; ramp: rise time)
     packed: str = "auto"               # "auto"|"on"|"off": single-device
                                        # packed epochs — when every worker
                                        # lives on ONE chip (the contention
@@ -436,6 +483,35 @@ class Config:
             raise ValueError("elastic_detect_misses must be >= 1")
         if self.elastic_readmit not in ("epoch", "off"):
             raise ValueError("elastic_readmit must be 'epoch' or 'off'")
+        if self.rebalance not in ("epoch", "window"):
+            raise ValueError("rebalance must be 'epoch' or 'window'")
+        if self.rebalance_every < 1:
+            raise ValueError("rebalance_every must be >= 1")
+        if self.rebalance_hysteresis < 0 or self.rebalance_margin < 0:
+            raise ValueError("rebalance hysteresis/margin must be >= 0")
+        if self.rebalance_budget_frac <= 0:
+            raise ValueError("rebalance_budget_frac must be > 0")
+        if not 0.0 < self.rebalance_rate_alpha <= 1.0:
+            raise ValueError("rebalance_rate_alpha must be in (0, 1]")
+        if self.fault_schedule not in ("none", "sin", "ramp"):
+            raise ValueError("fault_schedule must be 'none', 'sin' or 'ramp'")
+        if self.fault_period <= 0:
+            raise ValueError("fault_period must be > 0 epochs")
+        if self.fault_schedule != "none" and not self.straggler:
+            raise ValueError(
+                "fault_schedule needs --straggler factors to modulate"
+            )
+        if self.rebalance == "window" and not self.dynamic_batch_size:
+            raise ValueError(
+                "rebalance=window is a DBS control-loop cadence; it needs "
+                "dynamic_batch_size on"
+            )
+        if self.rebalance == "window" and self.fused_dbs:
+            raise ValueError(
+                "rebalance=window retires windows mid-epoch on the elastic "
+                "dispatch paths; the fused-DBS whole-epoch scan has no "
+                "window boundary to act at"
+            )
         if self.elastic == "on" and self.shard_update:
             raise ValueError(
                 "elastic world size re-places a REPLICATED state across a "
@@ -671,6 +747,39 @@ def get_parser() -> argparse.ArgumentParser:
                         "next epoch boundary (probe-seeded share), or never.")
     p.add_argument("--elastic_max_recoveries", type=int,
                    default=d.elastic_max_recoveries)
+    p.add_argument("--rebalance", type=str, default=d.rebalance,
+                   choices=["epoch", "window"],
+                   help="DBS control-loop cadence: epoch = one re-solve per "
+                        "epoch (reference semantics); window = the online "
+                        "hysteresis controller re-solves every "
+                        "rebalance_every windows and switches plans "
+                        "MID-epoch when the predicted remaining-epoch win "
+                        "beats the measured switch cost.")
+    p.add_argument("--rebalance_every", type=int, default=d.rebalance_every,
+                   help="Window cadence: evaluate the online controller "
+                        "every K dispatch windows.")
+    p.add_argument("--rebalance_hysteresis", type=float,
+                   default=d.rebalance_hysteresis,
+                   help="Relative switch threshold: predicted win as a "
+                        "fraction of predicted remaining-epoch time.")
+    p.add_argument("--rebalance_margin", type=float,
+                   default=d.rebalance_margin,
+                   help="Absolute switch threshold: win must exceed margin "
+                        "x the measured (EMA) switch cost.")
+    p.add_argument("--rebalance_budget_frac", type=float,
+                   default=d.rebalance_budget_frac,
+                   help="Regret budget: cumulative switch spend capped at "
+                        "this fraction of cumulative banked wins.")
+    p.add_argument("--rebalance_rate_alpha", type=float,
+                   default=d.rebalance_rate_alpha,
+                   help="EMA weight on the newest per-worker rate sample.")
+    p.add_argument("--fault_schedule", type=str, default=d.fault_schedule,
+                   choices=["none", "sin", "ramp"],
+                   help="Time-varying straggler schedule over the "
+                        "--straggler factors (sin: smooth appear/disappear "
+                        "per period; ramp: rise once and hold).")
+    p.add_argument("--fault_period", type=float, default=d.fault_period,
+                   help="Schedule period in epochs.")
     p.add_argument("--packed", type=str, default=d.packed,
                    choices=["auto", "on", "off"],
                    help="Single-device packed epochs: concat all workers' "

@@ -22,6 +22,7 @@ from dynamic_load_balance_distributeddnn_tpu.data.partitioner import (
     EpochPlan,
     WorkerPlan,
     build_epoch_plan,
+    build_remainder_plan,
     partition_indices,
 )
 
@@ -37,5 +38,6 @@ __all__ = [
     "EpochPlan",
     "WorkerPlan",
     "build_epoch_plan",
+    "build_remainder_plan",
     "partition_indices",
 ]

@@ -115,6 +115,71 @@ class EpochPlan:
         return idx, mask
 
 
+def build_remainder_plan(
+    plan: EpochPlan,
+    s_done: int,
+    batch_sizes: Sequence[int],
+    bucket: int = 16,
+) -> EpochPlan:
+    """Re-partition the UNVISITED tail of an in-flight epoch under new batch
+    sizes — the actuation step of the window-cadence online controller
+    (ISSUE 11).
+
+    Steps ``[0, s_done)`` of ``plan`` have executed (or are staged, hence
+    immutable); the examples they visited are gone. The remaining pool —
+    each worker's unvisited indices, concatenated in rank order (already
+    epoch-shuffled, so no re-shuffle and no rng) — is split contiguously by
+    the new shares, exactly the reference's truncating split
+    (dataloader.py:42-46). The result is a standalone plan whose step ``s``
+    corresponds to ABSOLUTE epoch step ``s_done + s``; the epoch's total
+    step count is invariant across the switch (``num_steps - s_done``
+    remaining), so combine cadence, rng-key indexing and the equal-step
+    invariant all survive. Deterministic in (plan, s_done, batch_sizes):
+    a mid-epoch switch and a fresh run started on the remainder plan from
+    the same state dispatch identical work (the bitwise-parity contract,
+    tests/test_online_dbs.py)."""
+    b_new = np.asarray(batch_sizes, dtype=np.int64)
+    if len(b_new) != len(plan.workers):
+        raise ValueError("batch_sizes length must equal the plan's world size")
+    if not 0 < s_done < plan.num_steps:
+        raise ValueError("s_done must be a strict mid-epoch step boundary")
+    rem = [
+        w.indices[min(s_done * max(w.batch_size, 1), len(w.indices)):]
+        for w in plan.workers
+    ]
+    pool = np.concatenate(rem) if rem else np.empty(0, dtype=np.int64)
+    shares = b_new.astype(np.float64) / max(b_new.sum(), 1)
+    num_steps = plan.num_steps - s_done
+    workers: List[WorkerPlan] = []
+    lo = 0
+    for rank, b in enumerate(b_new):
+        b = int(max(b, 1))
+        ln = int(shares[rank] * len(pool))
+        # the epoch's step count is invariant across the switch: indices a
+        # larger share cannot visit inside the remaining steps are dropped
+        # (the same truncation discipline as partition_indices)
+        ln = min(ln, b * num_steps)
+        part = pool[lo : lo + ln].copy()
+        lo += ln
+        workers.append(
+            WorkerPlan(
+                rank=rank,
+                indices=part,
+                batch_size=b,
+                padded_batch=-(-b // bucket) * bucket,
+                steps=max(min(-(-len(part) // b), num_steps), 1),
+            )
+        )
+    return EpochPlan(
+        epoch=plan.epoch,
+        shares=shares,
+        batch_sizes=b_new,
+        workers=tuple(workers),
+        num_steps=num_steps,
+        global_batch=plan.global_batch,
+    )
+
+
 def build_epoch_plan(
     n: int,
     shares: Sequence[float],

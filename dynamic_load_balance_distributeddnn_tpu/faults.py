@@ -169,6 +169,94 @@ class StaticStragglerInjector(FaultInjector):
         return out
 
 
+class ScheduledStragglerInjector(StaticStragglerInjector):
+    """Time-VARYING straggler profile — the scenario epoch-cadence DBS cannot
+    touch (ISSUE 11). The per-worker slowdown factor follows a deterministic
+    schedule over fractional epoch-time ``t``:
+
+    * ``sin``: factor_r(t) = 1 + (f_r - 1) * 0.5 * (1 - cos(2*pi*t/period))
+      — smooth 0 -> full -> 0 per ``period`` epochs, so a straggler appears
+      and disappears MID-epoch;
+    * ``ramp``: gain rises linearly from 0 to 1 over ``period`` epochs and
+      holds — a worker that degrades once and stays degraded.
+
+    Two cadences of the same schedule:
+
+    * :meth:`epoch_faults` (the classic injector surface) returns the
+      epoch-MEAN factors — the best an epoch-cadence controller can ever see;
+    * :meth:`faults_at` returns the instantaneous factors at ``t`` — the
+      per-window signal the online rebalance controller
+      (balance/controller.py) folds into its EMA rate estimates, and the
+      engine's window loop re-stages compute-mode injection from.
+
+    Deterministic (no rng): the realized schedule replays bit-for-bit, so
+    the window-vs-epoch cadence A/B (bench ``online_dbs_ab``) compares arms
+    under the identical injected trajectory."""
+
+    def __init__(
+        self,
+        factors: Sequence[float],
+        mode: str = "virtual",
+        schedule: str = "sin",
+        period: float = 2.0,
+        phase: float = 0.0,
+    ):
+        super().__init__(factors, mode)
+        if schedule not in ("sin", "ramp"):
+            raise ValueError("schedule must be 'sin' or 'ramp'")
+        if period <= 0:
+            raise ValueError("period must be > 0 epochs")
+        self.schedule = schedule
+        self.period = float(period)
+        self.phase = float(phase)
+
+    def gain(self, t: float) -> float:
+        """Schedule gain in [0, 1] at fractional epoch-time ``t``."""
+        x = (float(t) - self.phase) / self.period
+        if self.schedule == "sin":
+            return 0.5 * (1.0 - np.cos(2.0 * np.pi * x))
+        return float(np.clip(x, 0.0, 1.0))
+
+    def factors_at(self, t: float) -> np.ndarray:
+        """Instantaneous per-worker slowdown factors at epoch-time ``t``."""
+        return 1.0 + (self.factors - 1.0) * self.gain(t)
+
+    def _mean_factors(self, epoch: float) -> np.ndarray:
+        # numeric mean over the epoch (64 midpoints): deterministic, exact
+        # enough for a signal that is itself probe-noise-limited, and one
+        # formula serves every schedule shape
+        ts = epoch + (np.arange(64) + 0.5) / 64.0
+        g = float(np.mean([self.gain(t) for t in ts]))
+        return 1.0 + (self.factors - 1.0) * g
+
+    def _to_faults(self, factors: np.ndarray, ctx) -> EpochFaults:
+        ws = len(self.factors)
+        out = EpochFaults.none(ws)
+        if self.mode == "virtual":
+            out.time_multipliers = np.asarray(factors, dtype=np.float64)
+            return out
+        if ctx.iter_cost_s and ctx.per_example_cost_s is not None:
+            extra_s_per_step = (
+                (factors - 1.0) * ctx.per_example_cost_s * ctx.batch_sizes
+            )
+            out.slow_iters_per_step = np.maximum(
+                np.round(extra_s_per_step / ctx.iter_cost_s), 0
+            ).astype(np.int64)
+        return out
+
+    def epoch_faults(self, epoch, num_batches, ctx):
+        """Epoch-cadence view: the epoch-MEAN of the schedule (an epoch-
+        cadence solver can only react to per-epoch aggregates — that lag is
+        exactly what the window controller removes)."""
+        return self._to_faults(self._mean_factors(float(epoch)), ctx)
+
+    def faults_at(self, t: float, ctx) -> EpochFaults:
+        """Window-cadence view: instantaneous faults at epoch-time ``t``.
+        The engine re-stages compute-mode slow iters per window from this,
+        and the online controller folds the multipliers into its rates."""
+        return self._to_faults(self.factors_at(t), ctx)
+
+
 @dataclasses.dataclass(frozen=True)
 class PreemptionEvent:
     """One scheduled worker outage.

@@ -58,9 +58,16 @@ _POISON = None
 
 
 def default_worker_count() -> int:
-    """Process workers when the config leaves it at 0 (auto): one per core,
-    capped — each worker is a full XLA runtime (~100 MB, ~2-4 s spawn)."""
-    return max(1, min(4, (os.cpu_count() or 2)))
+    """Process workers when the config leaves it at 0 (auto). Adaptive on
+    many-core hosts (PR 5 follow-up): each worker is a full XLA runtime
+    (~100 MB, ~2-4 s spawn), so small hosts keep the old one-per-core cap
+    of 4, while hosts with cores to spare scale to half the cores capped at
+    8 — the regime the process backend exists for (per-program compiles
+    stop sharing an emitter once cores > concurrent programs)."""
+    cpus = os.cpu_count() or 2
+    if cpus <= 8:
+        return max(1, min(4, cpus))
+    return min(8, cpus // 2)
 
 
 def ensure_persistent_cache(logger=None) -> Optional[str]:
@@ -107,15 +114,115 @@ def ensure_persistent_cache(logger=None) -> Optional[str]:
         return None
 
 
+# ---------------------------------------------------------------------------
+# jax-internal-surface pinning (PR 5 follow-up): extract_lowering_payload
+# rides on ``pxla.create_compile_options``, a private jax function whose
+# signature has no stability contract. Rather than letting a jax upgrade
+# silently turn every offload into a blanket ``except Exception`` fallback
+# (the process backend would quietly degrade to the thread backend), the
+# capability is resolved ONCE per process against a pinned signature table:
+# a known surface yields a versioned adapter, drift yields a clear one-time
+# diagnostic naming the observed signature. New jax surfaces get a new row
+# here, not a rewrite at every call site.
+
+# parameter-name tuple -> adapter version tag. jax 0.4.30-0.5.x surface:
+_PAYLOAD_SURFACES: Dict[Tuple[str, ...], str] = {
+    (
+        "computation", "mesh", "spmd_lowering", "tuple_args",
+        "auto_spmd_lowering", "allow_prop_to_inputs",
+        "allow_prop_to_outputs", "backend", "np_dev", "pmap_nreps",
+        "compiler_options",
+    ): "v1",
+}
+_payload_api_cache: Optional[Dict[str, Any]] = None
+
+
+def payload_capability() -> Dict[str, Any]:
+    """Import-time-style capability check for the lowering-payload
+    extraction, resolved once per process: ``{"available", "version",
+    "reason"}``. Available means ``pxla.create_compile_options`` exists AND
+    its signature matches a pinned surface this module was written against;
+    anything else is reported as drift with the observed signature, so a
+    jax upgrade fails LOUD (one diagnostic) instead of silently disabling
+    the process compile backend."""
+    global _payload_api_cache
+    if _payload_api_cache is not None:
+        return _payload_api_cache
+    cap: Dict[str, Any]
+    try:
+        import inspect
+
+        from jax._src.interpreters import pxla
+
+        fn = getattr(pxla, "create_compile_options", None)
+        if fn is None:
+            cap = {
+                "available": False,
+                "version": None,
+                "reason": "jax._src.interpreters.pxla.create_compile_options "
+                "no longer exists (jax internal surface drift)",
+            }
+        else:
+            params = tuple(inspect.signature(fn).parameters)
+            version = _PAYLOAD_SURFACES.get(params)
+            if version is None:
+                cap = {
+                    "available": False,
+                    "version": None,
+                    "reason": (
+                        "pxla.create_compile_options signature drifted: "
+                        f"observed {params!r}, known surfaces "
+                        f"{sorted(_PAYLOAD_SURFACES.values())} — add the new "
+                        "surface to _PAYLOAD_SURFACES in "
+                        "runtime/compile_worker.py"
+                    ),
+                }
+            else:
+                cap = {"available": True, "version": version, "reason": ""}
+    except Exception as e:  # pragma: no cover - import surface drift
+        cap = {
+            "available": False,
+            "version": None,
+            "reason": f"jax internals unimportable: {e!r}",
+        }
+    _payload_api_cache = cap
+    return cap
+
+
+_payload_drift_warned = False
+
+
+def _warn_payload_drift(reason: str) -> None:
+    global _payload_drift_warned
+    if _payload_drift_warned:
+        return
+    _payload_drift_warned = True
+    import warnings
+
+    warnings.warn(
+        "compile workers: lowering-payload extraction disabled — "
+        f"{reason}; AOT jobs degrade to in-process compiles (the thread "
+        "backend)",
+        RuntimeWarning,
+        stacklevel=2,
+    )
+
+
 def extract_lowering_payload(lowered) -> Optional[Dict[str, Any]]:
     """Self-contained compile job from a ``jax.stages.Lowered``: MLIR
     bytecode + the exact serialized ``CompileOptions`` the parent's own
     ``lowered.compile()`` will use, so the worker's cache write and the
     parent's replay share one cache key. Returns None when the program
     cannot be offloaded (host callbacks, AUTO shardings, pmap-style
-    replication) — the caller then compiles in-process as before."""
+    replication) — the caller then compiles in-process as before — or when
+    the pinned jax internal surface drifted (:func:`payload_capability`;
+    one loud diagnostic, then clean degradation)."""
     import numpy as np
 
+    cap = payload_capability()
+    if not cap["available"]:
+        _warn_payload_drift(cap["reason"])
+        return None
     try:
         from jax._src.interpreters import mlir, pxla
         from jax._src.sharding_impls import AUTO, UnspecifiedValue

@@ -71,8 +71,17 @@ from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
 
 def default_pool_size() -> int:
     """Pool width when the config leaves it at 0 (auto): enough to keep the
-    backend compiler busy without convoying tracing threads on the GIL."""
-    return max(2, min(8, os.cpu_count() or 2))
+    backend compiler busy without convoying tracing threads on the GIL.
+
+    Adaptive on many-core hosts (PR 5 follow-up): the old fixed ``min(8,
+    cpus)`` left a 56-core TPU host's compile throughput capped at 8 while
+    the warm universe holds dozens of programs. Scale with ~3/4 of the
+    cores (the rest keep the controller thread, transfer pipeline and
+    allocator responsive), capped at 16 — beyond that, concurrent XLA:CPU
+    program compiles contend on shared emitter state instead of speeding
+    up (bench compile_workers_ab's thread-leg plateau)."""
+    cpus = os.cpu_count() or 2
+    return max(2, min(16, (cpus * 3) // 4))
 
 
 # Ceiling on one worker job's wall (submit -> ack). Generous: the slowest
@@ -450,6 +459,19 @@ class AOTCompileService:
             except BaseException as e:
                 failures.append((key, e))
         return failures
+
+    def failed(self, key: Hashable) -> bool:
+        """Did ``key``'s job finish with an exception? Failed keys stay in
+        the dedup table (never retried) and ``get`` returns None for them
+        forever — callers that gate on readiness (the online controller's
+        warm gate) must distinguish 'still compiling' from 'will never
+        arrive', or one failed candidate compile would defer every switch
+        for the rest of the run."""
+        with self._lock:
+            fut = self._jobs.get(key)
+        if fut is None or not fut.done():
+            return False
+        return fut.exception() is not None
 
     def pending(self) -> int:
         with self._lock:

@@ -42,14 +42,20 @@ from dynamic_load_balance_distributeddnn_tpu.balance import (
     integer_batch_split,
     rebalance,
 )
+from dynamic_load_balance_distributeddnn_tpu.balance.controller import (
+    OnlineRebalanceController,
+    step_time,
+)
 from dynamic_load_balance_distributeddnn_tpu.balance.solver import (
     ShareTrajectoryPredictor,
+    equilibrium_shares,
     quantize_batches,
 )
 from dynamic_load_balance_distributeddnn_tpu.config import Config
 from dynamic_load_balance_distributeddnn_tpu.data import (
     DatasetBundle,
     build_epoch_plan,
+    build_remainder_plan,
     load_dataset,
 )
 from dynamic_load_balance_distributeddnn_tpu.faults import (
@@ -58,6 +64,7 @@ from dynamic_load_balance_distributeddnn_tpu.faults import (
     FaultInjector,
     LuckyFaultInjector,
     NullInjector,
+    ScheduledStragglerInjector,
     StaticStragglerInjector,
 )
 from dynamic_load_balance_distributeddnn_tpu.models import build_model
@@ -217,6 +224,17 @@ class Trainer:
 
         if injector is not None:
             self.injector = injector
+        elif cfg.straggler and cfg.fault_schedule != "none":
+            # time-varying profile (ISSUE 11): the factors follow a sin/ramp
+            # schedule within epochs — the scenario window-cadence
+            # rebalancing exists for (epoch_faults still exposes the
+            # epoch-MEAN view, so epoch-cadence runs stay well-defined)
+            self.injector = ScheduledStragglerInjector(
+                cfg.straggler_factors(),
+                mode=cfg.fault_mode,
+                schedule=cfg.fault_schedule,
+                period=cfg.fault_period,
+            )
         elif cfg.straggler:
             self.injector = StaticStragglerInjector(
                 cfg.straggler_factors(), mode=cfg.fault_mode
@@ -343,6 +361,19 @@ class Trainer:
         # but the NEXT tuple is a deterministic function of the next share
         # vector, which the solver's smooth trajectory makes predictable.
         self._share_predictor = ShareTrajectoryPredictor()
+        # Online window-cadence rebalance controller (ISSUE 11,
+        # balance/controller.py): lazily built per fleet generation by
+        # _window_controller() when cfg.rebalance == "window"; its EMA rate
+        # track and regret ledger persist across epochs, and speculation is
+        # re-aimed at ITS candidate plans (the switched-to executables are
+        # always AOT-warm — a switch never pays a foreground compile).
+        self._rebalance_ctl: Optional[OnlineRebalanceController] = None
+        self._rebalance_events: list = []
+        self._switches_last = 0
+        self._window_rebalance_logged = False
+        self._fault_ctx: Optional[FaultContext] = None
+        self._clean_compute_s: Optional[np.ndarray] = None
+        self._clean_examples: Optional[np.ndarray] = None
         # graftscope (obs/trace.py + obs/registry.py): the process-wide span
         # tracer — configured here from the run config, shared by every
         # instrumented module (pipeline, AOT service, solver, watchdog) —
@@ -966,9 +997,18 @@ class Trainer:
         cap = min(1.0, cfg.capacity_factor / self.world_size)
         if cap * self.world_size < 1.0:
             return  # infeasible cap (capacity_factor < 1): nothing to match
-        batches = self._share_predictor.predict_batches(
-            cfg.batch_size, bucket=bucket, max_share=cap
-        )
+        ctl = self._rebalance_ctl
+        if ctl is not None and ctl.last_candidate_batches is not None:
+            # window-cadence runs: speculation is RE-AIMED at the online
+            # controller's candidate plan — its EMA-rate solve is the plan a
+            # mid-epoch switch (or the next epoch's boundary solve, seeded
+            # from the switched shares) will actually dispatch, so a hit
+            # keeps switches foreground-compile-free
+            batches = np.asarray(ctl.last_candidate_batches, dtype=np.int64)
+        else:
+            batches = self._share_predictor.predict_batches(
+                cfg.batch_size, bucket=bucket, max_share=cap
+            )
         if batches is None:
             return
         # epoch index only seeds the plan's permutation; shapes are epoch-free
@@ -1668,6 +1708,14 @@ class Trainer:
         # would restart its velocity track on shape change anyway; a fresh
         # instance makes it explicit)
         self._share_predictor = ShareTrajectoryPredictor()
+        # the online controller's per-worker rate track and device-group
+        # step-time model are fleet-shaped: rebuilt lazily against the
+        # survivor topology (ledger restarts — a new fleet, a new account;
+        # executed-switch events stay in self._rebalance_events). The
+        # recorder's per-epoch switch-delta baseline restarts with it, or
+        # the first post-reshard epoch would record a negative delta.
+        self._rebalance_ctl = None
+        self._switches_last = 0
         # warm-started runs re-warm the NEW world size's compile universe:
         # _maybe_warm (next epoch entry) submits the gen's ladder to the
         # AOT service and the pre-wall drain keeps the compiles out of
@@ -1842,8 +1890,7 @@ class Trainer:
                 cost[i] = probed if probed is not None else fallback
             self.per_example_cost = cost
             if np.isfinite(cost).all() and (cost > 0).all():
-                inv = 1.0 / cost
-                self.shares = inv / inv.sum()
+                self.shares = equilibrium_shares(cost)
                 # t_i = c_i * p_i is the epoch-time model the solver's
                 # update inverts; seeding times consistently with the
                 # seeded shares makes the next rebalance a fixed point of
@@ -1991,6 +2038,9 @@ class Trainer:
                 else None
             ),
         )
+        # kept for the window controller's per-window faults_at queries
+        # (re-derived per segment after a mid-epoch switch — _window_ctx)
+        self._fault_ctx = ctx
         faults = self._faults_active(
             self.injector.epoch_faults(epoch, plan.num_steps, ctx)
         )
@@ -2031,6 +2081,23 @@ class Trainer:
                 "(one worker per device); this plan fell back to the elastic "
                 "path"
             )
+        if cfg.rebalance == "window" and (
+            self._can_use_fused(plan)
+            or self._can_use_fused_dbs(plan)
+            or self._can_use_packed(plan)
+        ):
+            # config validation already forbids fused_dbs, but packed/fused
+            # selection depends on the runtime topology — without this the
+            # controller would silently never engage (exactly the
+            # contention topology window rebalancing targets)
+            if not self._window_rebalance_logged:
+                self._window_rebalance_logged = True
+                self.logger.warning(
+                    "rebalance=window needs the elastic dispatch paths but "
+                    "this topology selected a fused/packed whole-epoch scan "
+                    "— running at epoch cadence (pass --packed off to force "
+                    "the elastic path)"
+                )
         if self._can_use_fused(plan):
             return self._train_epoch_fused(plan, faults, epoch), False
         if self._can_use_fused_dbs(plan):
@@ -2141,6 +2208,18 @@ class Trainer:
             self._model_compute_times(plan, faults)
         self._update_probe_schedule(epoch, plan, faults, epoch_wall, train_metrics)
 
+        # multiplier-free compute vector: the window controller's fallback
+        # rate source (node_times below bakes in the epoch-mean injection
+        # multipliers — composing the instantaneous schedule on top of them
+        # would double-count the injected load). Stored WITH the example
+        # counts of the plan it was measured under: a boundary re-solve
+        # changes per-worker counts, and normalizing old seconds by new
+        # counts would skew the derived rates by the share ratio.
+        self._clean_compute_s = self.timekeeper.compute_s.copy()
+        self._clean_examples = np.array(
+            [max(w.batch_size, 1) * max(w.steps, 1) for w in plan.workers],
+            dtype=np.float64,
+        )
         node_times = (
             self.timekeeper.compute_s * faults.time_multipliers
             + self.timekeeper.injected_s
@@ -2180,6 +2259,14 @@ class Trainer:
             # recoveries counts completed recovery cycles
             extras["workers_alive"] = float(self.world_size)
             extras["recoveries"] = float(self._recoveries)
+        if self._rebalance_ctl is not None:
+            # online controller observables: mid-epoch plan switches this
+            # epoch (the no-thrash property the tests bound) + the full
+            # ledger snapshot for offline tooling / the bench field
+            ctl = self._rebalance_ctl
+            extras["plan_switches"] = float(ctl.switches - self._switches_last)
+            self._switches_last = ctl.switches
+            self.recorder.meta["rebalance_controller"] = ctl.snapshot()
         # elastic-path host-overhead walls (superstep A/B instrumentation;
         # absent on the fused paths, whose dispatch is one scan per window)
         for k in ("host_dispatch_s", "host_put_s", "host_overhead_per_step_s"):
@@ -2231,6 +2318,15 @@ class Trainer:
             (int(plan.num_steps),)
             + tuple((int(w.padded_batch), int(w.steps)) for w in plan.workers)
             + tuple(s1 - s0 for s0, s1 in self._elastic_ranges(plan.num_steps))
+            # mid-epoch switches (rebalance=window) dispatch ADDITIONAL
+            # layouts inside the same epoch: fold their (step, sizes)
+            # signature in so a lazily-compiled switch tuple never reads as
+            # a recompile of an already-executed layout
+            + tuple(
+                (int(ev["step"]),) + tuple(ev["batches"])
+                for ev in self._rebalance_events
+                if ev.get("epoch") == epoch
+            )
         )
         layout_seen = plan_layout in self._seen_plan_layouts
         self._seen_plan_layouts.add(plan_layout)
@@ -3031,51 +3127,329 @@ class Trainer:
                 )
                 self.state = combine(self.state, stacked)
 
-    def _train_epoch_elastic(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:
+    # ------------------------------------------- online window rebalancing
+    # (ISSUE 11, balance/controller.py). The epoch-cadence loop re-solves
+    # the partition once per epoch; under a time-varying straggler (the
+    # sin/ramp schedules) that lag is the whole cost. At window cadence the
+    # controller folds the per-window signal (EMA rates x the injector's
+    # instantaneous multipliers, scaled by measured step-wall feedback) into
+    # the same inverse-time solve, and — under hysteresis plus a regret-
+    # style budget — retires the REMAINING windows under the new plan:
+    # staged windows keep their data (nothing on device is re-staged,
+    # train/pipeline.py), future windows re-slice the unvisited example
+    # pool through data/partitioner.py build_remainder_plan.
+
+    def _window_controller(self) -> Optional[OnlineRebalanceController]:
+        cfg = self.cfg
+        if cfg.rebalance != "window" or not cfg.dynamic_batch_size:
+            return None
+        if self.n_proc > 1:
+            # the switch decision folds LOCALLY measured walls — a gate that
+            # can diverge per process would desynchronize the combine
+            # collectives mid-epoch
+            if not self._window_rebalance_logged:
+                self._window_rebalance_logged = True
+                self.logger.warning(
+                    "rebalance=window is single-process only — falling back "
+                    "to epoch cadence"
+                )
+            return None
+        if self._rebalance_ctl is None:
+            topo = self.topology
+            self._rebalance_ctl = OnlineRebalanceController(
+                self.world_size,
+                cfg.batch_size,
+                [topo.groups[d] for d in topo.used_device_indices],
+                bucket=(
+                    cfg.bucket if (cfg.snap_to_bucket and self.SNAP_BATCHES) else 0
+                ),
+                max_share=min(1.0, cfg.capacity_factor / self.world_size),
+                hysteresis=cfg.rebalance_hysteresis,
+                margin=cfg.rebalance_margin,
+                budget_frac=cfg.rebalance_budget_frac,
+                rate_alpha=cfg.rebalance_rate_alpha,
+                logger=self.logger,
+            )
+        return self._rebalance_ctl
+
+    def _window_rates(self) -> Optional[np.ndarray]:
+        """Base (injection-free) per-worker per-example rates for the
+        controller: the probe anchors when they exist, else the last
+        epoch's multiplier-free compute vector normalized by the plan's
+        per-worker example counts. None before any real signal exists
+        (epoch 0 cold start) — the caller then evaluates on a unit base
+        (the schedule's relative multipliers still steer the solve) but
+        MUST NOT fold the placeholder into the controller's EMA: its
+        arbitrary scale would drown the absolute compute-mode injection
+        term for many evaluations (0.5-EMA half-life)."""
+        c = self.per_example_cost.copy()
+        if np.isfinite(c).all() and (c > 0).all():
+            return np.maximum(c, 1e-12)
+        clean = self._clean_compute_s
+        examples = getattr(self, "_clean_examples", None)
+        if (
+            clean is not None
+            and examples is not None
+            and len(clean) == self.world_size
+            and len(examples) == self.world_size
+            and (clean > 0).all()
+        ):
+            # normalize by the example counts of the SAME epoch the seconds
+            # were measured under, not the current plan's
+            return np.maximum(clean / np.maximum(examples, 1.0), 1e-12)
+        return None
+
+    def _window_ctx(self, pl) -> FaultContext:
+        """FaultContext against the CURRENT segment's batch sizes (after a
+        switch the injected compute must track the new split, or the
+        delivered slowdown factors drift off the schedule)."""
+        return FaultContext(
+            batch_sizes=self._scatter_full(pl.batch_sizes.astype(np.float64)),
+            iter_cost_s=self._iter_cost_s if self._needs_iter_cost else None,
+            per_example_cost_s=(
+                self._scatter_full(self.per_example_cost)
+                if np.isfinite(self.per_example_cost).all()
+                else None
+            ),
+        )
+
+    def _window_faults_at(self, t: float, pl) -> Optional[EpochFaults]:
+        """The injector's instantaneous (window-cadence) fault view at
+        epoch-time ``t``, compacted to the active fleet — None for
+        injectors without a time-varying surface."""
+        fa = getattr(self.injector, "faults_at", None)
+        if fa is None:
+            return None
+        return self._faults_active(fa(t, self._window_ctx(pl)))
+
+    def _effective_rates(
+        self, rates: np.ndarray, wf: Optional[EpochFaults], batches: np.ndarray
+    ) -> np.ndarray:
+        """Compose the base rates with the window's fault view: virtual
+        multipliers scale, compute-mode slow iters add their per-example
+        equivalent at the current split."""
+        eff = np.asarray(rates, dtype=np.float64).copy()
+        if wf is None:
+            return eff
+        eff = eff * np.asarray(wf.time_multipliers, dtype=np.float64)
+        if self._needs_iter_cost and self._iter_cost_s:
+            extra = self._iter_cost_s * np.asarray(
+                wf.slow_iters_per_step, dtype=np.float64
+            )
+            eff = eff + extra / np.maximum(
+                np.asarray(batches, dtype=np.float64), 1.0
+            )
+        return eff
+
+    def _aot_submit_candidate(
+        self, batches: np.ndarray, ranges, j: int
+    ) -> tuple:
+        """Speculatively queue the executables a switch onto ``batches``
+        would dispatch for windows >= j (scan: the superstep shape-tuple
+        keys; ladder modes: the per-worker rungs at the remaining window
+        lengths). The engine only EXECUTES a switch once these resolve —
+        warm gating — so a switch never pays a foreground compile."""
+        if self._aot is None:
+            return ()
         cfg = self.cfg
         topo = self.topology
-        self.timekeeper.reset()
+        padded = [
+            -(-int(max(b, 1)) // cfg.bucket) * cfg.bucket for b in batches
+        ]
+        wins = tuple(sorted({s1 - s0 for s0, s1 in ranges[j:]}))
+        keys: list = []
+        if self._elastic_mode() == "scan":
+            d0 = topo.used_device_indices[0]
+            group_pad = [padded[self.rank_lo + r] for r in topo.groups[d0]]
+            for win in wins:
+                keys += self._aot_submit_superstep(
+                    group_pad, win, speculative=True
+                )
+        else:
+            win_arg = wins if self._elastic_mode() == "window" else ()
+            for d in topo.used_device_indices:
+                group = topo.groups[d]
+                want_acc = len(group) > 1
+                for r in group:
+                    keys += self._aot_submit_worker_steps(
+                        d, padded[self.rank_lo + r], win_arg, want_acc,
+                        want_plain=True, speculative=True,
+                    )
+        return tuple(dict.fromkeys(keys))
+
+    def _maybe_window_rebalance(
+        self, ctl, plan, seg_plans, ranges, pipe, i, epoch,
+        aux_acc, aux_windows, eval_state,
+    ) -> None:
+        """One controller evaluation at the boundary after window ``i``:
+        fold the signal, propose, speculate at the candidate, and — when
+        the hysteresis verdict is a warm-gated switch — re-slice the
+        remaining windows under the new plan."""
+        j = pipe.next_unlaunched()
+        if j >= len(ranges):
+            return  # every window already staged — no horizon left to act on
+        s_switch = ranges[j][0]
+        remaining = plan.num_steps - s_switch
+        if remaining <= 0:
+            return
+        with self._trace.span(
+            "controller", cat="solve", args={"window": i, "epoch": epoch}
+        ):
+            t_eval0 = time.perf_counter()
+            cur_pl, cur_off = self._seg_for_step(seg_plans, s_switch)
+            cur_batches = np.asarray(cur_pl.batch_sizes, dtype=np.int64)
+            base = self._window_rates()
+            if base is not None:
+                ctl.observe_rates(base)
+            t_next = float(epoch) + (ranges[j][0] + ranges[j][1]) / (
+                2.0 * max(plan.num_steps, 1)
+            )
+            wf = self._window_faults_at(t_next, cur_pl)
+            rates = ctl.rates
+            if rates is None:
+                rates = np.ones(self.world_size, dtype=np.float64)
+            eff = self._effective_rates(rates, wf, cur_batches)
+            # step-wall feedback (real clocks only): sync on the last
+            # dispatched window and compare the measured wall of the steps
+            # since the previous evaluation against the model's prediction
+            if self.timing_model is None:
+                last_aux = (aux_windows or aux_acc)[-1:] or None
+                if last_aux is not None:
+                    jax.block_until_ready(last_aux)
+                now = time.perf_counter()
+                # host-side dispatch walls since the last evaluation
+                # (balance/timing.py mark_window): the measured wall below
+                # includes them, the model predicts device compute only —
+                # subtracting keeps the feedback scale a compute signal
+                host_s, _, _ = self._host_meter.mark_window()
+                done = ranges[i][1] - eval_state["step"]
+                if eval_state["step"] > 0 and done > 0 and eval_state.get("pred_step"):
+                    # compare against the prediction STORED at the previous
+                    # evaluation — the same windows, the same schedule
+                    # phase, the same batch split; modeling the past stretch
+                    # with the NEXT window's fault view would bias the scale
+                    # under exactly the time-varying schedules the
+                    # controller targets
+                    ctl.observe_wall(
+                        max(now - eval_state["t"] - host_s, 1e-9),
+                        eval_state["pred_step"] * done,
+                    )
+                eval_state["t"] = now
+                eval_state["step"] = ranges[i][1]
+            dec = ctl.propose(eff, cur_batches, remaining)
+            keys: tuple = ()
+            if dec.candidate_batches is not None and not np.array_equal(
+                dec.candidate_batches, cur_batches
+            ):
+                keys = self._aot_submit_candidate(
+                    dec.candidate_batches, ranges, j
+                )
+            apply = dec.switch
+            if apply and self._aot is not None and keys:
+                missing = [k for k in keys if self._aot.get(k) is None]
+                dead = [k for k in missing if self._aot.failed(k)]
+                if dead:
+                    # a candidate executable FAILED to compile: deferring
+                    # would silently disable window rebalancing for the
+                    # rest of the run (failed keys never resolve) — switch
+                    # anyway and let dispatch's lazy-jit fallback compile
+                    # foreground, logging once per key
+                    for k in dead:
+                        if k not in self._aot_failed_logged:
+                            self._aot_failed_logged.add(k)
+                            self.logger.warning(
+                                f"online-dbs: candidate executable {k} "
+                                "failed its background compile — switching "
+                                "via the lazy fallback (one foreground "
+                                "compile)"
+                            )
+                elif missing:
+                    # warm gate: still compiling in the background — defer;
+                    # the hysteresis re-evaluates at the next cadence
+                    # boundary, by which time the speculative submit above
+                    # has usually landed
+                    ctl.note_deferred()
+                    apply = False
+            if apply:
+                rplan = build_remainder_plan(
+                    cur_pl, s_switch - cur_off, dec.candidate_batches,
+                    bucket=self.cfg.bucket,
+                )
+                seg_plans.append((s_switch, rplan))
+                self.shares = np.asarray(dec.candidate_shares, dtype=np.float64)
+                # the MEASURED switch cost covers the whole evaluation-to-
+                # apply wall (device sync, signal build, solve, candidate
+                # staging, remainder re-slice) — the host price an extra
+                # switch actually pays. The plan build alone is microseconds
+                # and would hollow out the margin/budget gates from the
+                # second switch on.
+                ev = ctl.commit(
+                    dec,
+                    time.perf_counter() - t_eval0,
+                    epoch=int(epoch),
+                    window=int(j),
+                    step=int(s_switch),
+                )
+                self._rebalance_events.append(ev)
+                self.recorder.meta["rebalance_events"] = self._rebalance_events
+            if self.timing_model is None:
+                # prediction for the stretch about to run, under the plan
+                # that will actually govern it (the switched segment when
+                # one was just applied) — next evaluation's feedback
+                # reference
+                nxt_pl, _ = self._seg_for_step(seg_plans, ranges[j][0])
+                groups_list = [
+                    self.topology.groups[d]
+                    for d in self.topology.used_device_indices
+                ]
+                eval_state["pred_step"] = step_time(
+                    eff, np.asarray(nxt_pl.batch_sizes, dtype=np.float64),
+                    groups_list,
+                )
+
+    @staticmethod
+    def _seg_for_step(seg_plans, s: int):
+        """The (plan, step_offset) governing absolute epoch step ``s``:
+        segments are (start_step, plan) in increasing order; a plan's local
+        step index is ``s - start_step``."""
+        pl, off = seg_plans[0][1], seg_plans[0][0]
+        for start, p in seg_plans:
+            if s >= start:
+                pl, off = p, start
+        return pl, off
+
+    def _run_elastic_windows(
+        self, plan, seg_plans, ranges, wkeys, faults: EpochFaults, epoch: int,
+        aux_acc: List, aux_windows: List, aot_needed=(), controller=None,
+    ):
+        """The elastic window loop over an (extensible) segment schedule:
+        gather/stage window k+1 on the transfer pipeline while window k
+        dispatches, with each window's plan resolved through ``seg_plans``
+        — the table a mid-epoch switch appends to for windows not yet
+        staged. Shared by the epoch path and the switch-parity replay
+        helper so both dispatch through identical machinery. Returns the
+        first window's host data (the probes reuse it)."""
+        cfg = self.cfg
+        topo = self.topology
         mode = self._elastic_mode()
         meter = self._host_meter
-        meter.reset()
-
-        # Local topo ranks r (0..ws_local-1) own global worker rank_lo + r.
         groups = topo.groups
         dev_order = topo.used_device_indices
-        aux_acc: List = []
-        aux_windows: List = []  # scan mode: [win, n_workers, 4] per window
-        sync_probe = 0.0
-        base_key = jax.random.PRNGKey(cfg.seed * 7919 + epoch)
-        wkeys = jax.random.split(base_key, self.world_size * max(plan.num_steps, 1))
-
         use_cache = self._use_device_cache
 
         def gather_window(s0: int, s1: int):
+            # segment lookup by STEP: gather runs on pipeline threads, but
+            # seg_plans only ever grows for windows the pipeline has not
+            # launched yet — ordered by the executor's submit, program-order
+            # safe (same discipline as _reshard_world's quiesced writes)
+            pl, off = self._seg_for_step(seg_plans, s0)
             return [
                 self._worker_inputs(
-                    plan, self.rank_lo + r, s0, s1, as_indices=use_cache
+                    pl, self.rank_lo + r, s0 - off, s1 - off,
+                    as_indices=use_cache,
                 )
                 for r in range(self.ws_local)
             ]
-
-        # Per-worker constants for the whole epoch: one transfer, not one per
-        # step (each device_put is a host round trip — 5 puts/worker/step was
-        # most of the elastic path's dispatch overhead).
-        slow_dev = {}
-        for d in dev_order:
-            dev = topo.devices[d]
-            for r in groups[d]:
-                gr = self.rank_lo + r
-                slow_dev[r] = jax.device_put(
-                    jnp.int32(faults.slow_iters_per_step[gr]), dev
-                )
-
-        ranges = self._elastic_ranges(plan.num_steps)
-
-        # AOT service: queue this plan's missing executables (concurrent
-        # background compiles) + speculative adjacent rungs; the barrier
-        # below overlaps with the first window's staging.
-        aot_needed = self._aot_stage_plan(plan)
 
         def stage_window(d: int, i: int, data):
             """One device's puts for one window: each worker's arrays plus
@@ -3093,12 +3467,30 @@ class Trainer:
                 ) + (jax.device_put(kwin, dev),)
             return staged
 
+        # Per-worker constants for the whole epoch: one transfer, not one
+        # per step (each device_put is a host round trip — 5 puts/worker/
+        # step was most of the elastic path's dispatch overhead). Under a
+        # time-varying schedule the values re-stage per window below.
+        slow_dev = {}
+        slow_vals: Dict[int, int] = {}
+        for d in dev_order:
+            dev = topo.devices[d]
+            for r in groups[d]:
+                gr = self.rank_lo + r
+                slow_vals[r] = int(faults.slow_iters_per_step[gr])
+                slow_dev[r] = jax.device_put(jnp.int32(slow_vals[r]), dev)
+        time_varying = (
+            getattr(self.injector, "faults_at", None) is not None
+            and self._needs_iter_cost
+        )
+
+        eval_state = {"t": time.perf_counter(), "step": 0}
+        first_data = None
         # Streaming host path, double-buffered per device: window k+1's host
         # gather AND its per-device puts run on the transfer pipeline while
         # window k dispatches/executes (train/pipeline.py). Window-local
         # rows, absolute-step rng keys — identical math to the whole-epoch
         # gather. Peak host memory: two windows, not the epoch.
-        first_data = None
         with WindowTransferPipeline(
             ranges, gather_window, stage_window, dev_order, meter=meter
         ) as pipe:
@@ -3115,13 +3507,33 @@ class Trainer:
                 data, staged = pipe.get(i)
                 if first_data is None:
                     first_data = data
+                pl, _ = self._seg_for_step(seg_plans, w0)
+                if time_varying:
+                    # re-stage compute-mode injection at the window's
+                    # instantaneous schedule value (scalar puts, only on
+                    # change) — the injected load follows the schedule at
+                    # window granularity, not the epoch mean
+                    t_mid = float(epoch) + (w0 + w1) / (
+                        2.0 * max(plan.num_steps, 1)
+                    )
+                    wf = self._window_faults_at(t_mid, pl)
+                    if wf is not None:
+                        for d in dev_order:
+                            for r in groups[d]:
+                                gr = self.rank_lo + r
+                                v = int(wf.slow_iters_per_step[gr])
+                                if slow_vals.get(r) != v:
+                                    slow_vals[r] = v
+                                    slow_dev[r] = jax.device_put(
+                                        jnp.int32(v), topo.devices[d]
+                                    )
                 # one span per window (not per step): the dispatch track in
                 # the trace shows window boundaries without per-step cost
                 with self._trace.span("dispatch_window", cat="dispatch"):
                     if mode == "scan":
                         d0 = dev_order[0]
                         win_key = topo.group_shape_key(
-                            [plan.workers[self.rank_lo + r].padded_batch
+                            [pl.workers[self.rank_lo + r].padded_batch
                              for r in groups[d0]],
                             w1 - w0,
                         )
@@ -3134,6 +3546,74 @@ class Trainer:
                             staged, w1 - w0, slow_dev, aux_acc,
                             windowed=(mode == "window"),
                         )
+                if controller is not None and (i + 1) % cfg.rebalance_every == 0:
+                    self._maybe_window_rebalance(
+                        controller, plan, seg_plans, ranges, pipe, i, epoch,
+                        aux_acc, aux_windows, eval_state,
+                    )
+        return first_data
+
+    def _replay_window_segment(
+        self, base_plan, rplan, s_offset: int, epoch: int, faults: EpochFaults
+    ):
+        """TEST/DEBUG: dispatch ONLY the remainder segment of an epoch from
+        the CURRENT state — the 'fresh run started on the new plan from the
+        same state' reference leg of the mid-epoch switch-parity contract
+        (tests/test_online_dbs.py). Uses the same window loop, rng-key
+        stream (absolute step indices over the BASE plan's step count) and
+        dispatch machinery as the in-epoch switch path."""
+        cfg = self.cfg
+        base_key = jax.random.PRNGKey(cfg.seed * 7919 + epoch)
+        wkeys = jax.random.split(
+            base_key, self.world_size * max(base_plan.num_steps, 1)
+        )
+        ranges = [
+            w for w in self._elastic_ranges(base_plan.num_steps)
+            if w[0] >= s_offset
+        ]
+        aux_acc: List = []
+        aux_windows: List = []
+        self._run_elastic_windows(
+            base_plan, [(s_offset, rplan)], ranges, wkeys, faults, epoch,
+            aux_acc, aux_windows,
+        )
+        jax.block_until_ready(self.state.params)
+        for aux in aux_windows:
+            aux_acc.extend(np.asarray(aux, dtype=np.float64).reshape(-1, 4))
+        return aux_acc
+
+    def _train_epoch_elastic(self, plan, faults: EpochFaults, epoch: int) -> Dict[str, float]:
+        cfg = self.cfg
+        topo = self.topology
+        self.timekeeper.reset()
+        mode = self._elastic_mode()
+        meter = self._host_meter
+        meter.reset()
+
+        # Local topo ranks r (0..ws_local-1) own global worker rank_lo + r.
+        aux_acc: List = []
+        aux_windows: List = []  # scan mode: [win, n_workers, 4] per window
+        sync_probe = 0.0
+        base_key = jax.random.PRNGKey(cfg.seed * 7919 + epoch)
+        wkeys = jax.random.split(base_key, self.world_size * max(plan.num_steps, 1))
+
+        use_cache = self._use_device_cache
+        ranges = self._elastic_ranges(plan.num_steps)
+
+        # AOT service: queue this plan's missing executables (concurrent
+        # background compiles) + speculative adjacent rungs; the barrier
+        # below overlaps with the first window's staging.
+        aot_needed = self._aot_stage_plan(plan)
+
+        # Segment schedule: the whole epoch under the boundary plan, until
+        # the online controller (rebalance=window) appends a remainder
+        # segment at a mid-epoch switch.
+        seg_plans: List = [(0, plan)]
+        first_data = self._run_elastic_windows(
+            plan, seg_plans, ranges, wkeys, faults, epoch,
+            aux_acc, aux_windows, aot_needed=aot_needed,
+            controller=self._window_controller(),
+        )
         if mode == "scan":
             # flatten the scanned aux back into the per-step path's exact
             # (step, worker) row order so the float64 metric summation below

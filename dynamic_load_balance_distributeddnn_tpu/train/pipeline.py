@@ -56,6 +56,19 @@ class WindowTransferPipeline:
             max_workers=len(self._devices) + 1
         )
         self._inflight: Dict[int, Tuple] = {}
+        self._launched_through = 0  # windows [0, N) whose gather/puts started
+
+    def next_unlaunched(self) -> int:
+        """First window index whose gather has NOT been kicked yet — the
+        earliest window a mid-epoch plan switch may re-slice (ISSUE 11):
+        windows already gathered/staged under the old plan are immutable
+        (their device buffers exist; re-staging them would waste the
+        transfer AND desynchronize the dispatch loop), so the online
+        controller retires only windows from this index on under the new
+        plan. The gather/stage callbacks see the switch through the
+        engine's segment table, not through this pipeline — window
+        boundaries are invariant across a switch by construction."""
+        return self._launched_through
 
     def _stage_device(self, d: int, i: int, gather_fut) -> object:
         data = gather_fut.result()
@@ -81,6 +94,7 @@ class WindowTransferPipeline:
             for d in self._devices
         }
         self._inflight[i] = (gather_fut, put_futs)
+        self._launched_through = max(self._launched_through, i + 1)
 
     def prefetch(self, i: int) -> None:
         """Kick window i's gather+puts without blocking on them — lets the

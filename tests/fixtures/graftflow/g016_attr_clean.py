@@ -1,0 +1,43 @@
+"""G016 negatives for the self-attr / container channels: the SAME store-
+on-self and append-into-container shapes, but the values pass the
+pad/quantize discipline BEFORE they are stored — the ladder widths a
+collective can legally see."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))
+
+
+def integer_batch_split(shares, global_batch):
+    return np.maximum((shares * global_batch).astype(np.int64), 1)
+
+
+def quantize_batches(batches, bucket, global_batch):
+    return np.maximum(batches // bucket, 1) * bucket
+
+
+class Controller:
+    def __init__(self):
+        self._sizes = None
+        self._cols = []
+
+    def plan(self, shares, global_batch, bucket):
+        raw = integer_batch_split(shares, global_batch)
+        self._sizes = quantize_batches(raw, bucket, global_batch)  # snapped
+
+    def dispatch(self, parts, pad_to):
+        shards = [np.pad(p, (0, pad_to - len(p))) for p in parts]  # padded
+        stacked = jnp.stack(shards)
+        return jax.lax.all_gather(stacked, "data")
+
+    def collect(self, shares, global_batch, bucket):
+        raw = integer_batch_split(shares, global_batch)
+        self._cols.append(quantize_batches(raw, bucket, global_batch))
+
+    def flush(self):
+        return jnp.stack(self._cols)

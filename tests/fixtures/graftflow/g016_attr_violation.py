@@ -1,0 +1,49 @@
+"""G016 seeds: plan taint through SELF-ATTRS and CONTAINER ELEMENTS.
+
+The window-cadence controller stores plan-derived sizes on ``self`` and
+packs per-worker columns into lists before dispatch — without these two
+channels the new code's riskiest sites are invisible to the lint gate.
+
+Shape 1 (self-attr): ``plan`` stores the raw ``integer_batch_split``
+output on ``self._sizes``; ``dispatch`` — a different method — slices
+per-worker shards to those widths and stacks them into a fixed-shape
+collective.
+
+Shape 2 (container element): ``collect`` appends the raw batch vector
+into ``self._cols`` (a container MUTATION, not a rebind); ``flush``
+device-stacks the container.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))
+
+
+def integer_batch_split(shares, global_batch):
+    return np.maximum((shares * global_batch).astype(np.int64), 1)
+
+
+class Controller:
+    def __init__(self):
+        self._sizes = None
+        self._cols = []
+
+    def plan(self, shares, global_batch):
+        self._sizes = integer_batch_split(shares, global_batch)
+
+    def dispatch(self, parts):
+        shards = [p[:b] for p, b in zip(parts, self._sizes)]  # raw widths
+        stacked = jnp.stack(shards)
+        return jax.lax.all_gather(stacked, "data")
+
+    def collect(self, shares, global_batch):
+        batches = integer_batch_split(shares, global_batch)
+        self._cols.append(batches)  # element mutation carries the taint
+
+    def flush(self):
+        return jnp.stack(self._cols)  # device concat of unequal columns

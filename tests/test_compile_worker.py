@@ -150,8 +150,52 @@ def test_payload_extraction_is_self_contained():
     assert extract_lowering_payload(object()) is None
 
 
-def test_pool_sizing_default():
-    assert 1 <= default_worker_count() <= 4
+def test_pool_sizing_default_adapts_to_cores(monkeypatch):
+    """Auto worker count scales with the host (PR 5 follow-up): small hosts
+    keep the old one-per-core cap of 4; many-core hosts get cpus/2 capped
+    at 8 — the regime where per-program compiles stop sharing an emitter."""
+    import os as _os
+
+    from dynamic_load_balance_distributeddnn_tpu.runtime import (
+        compile_worker as cw,
+        compiler as rc,
+    )
+
+    assert 1 <= default_worker_count() <= 8
+    for cpus, want_workers in ((1, 1), (4, 4), (8, 4), (16, 8), (64, 8)):
+        monkeypatch.setattr(_os, "cpu_count", lambda n=cpus: n)
+        assert cw.default_worker_count() == want_workers, cpus
+    # thread-pool width: ~3/4 of cores, floor 2, cap 16
+    for cpus, want_pool in ((1, 2), (4, 3), (8, 6), (16, 12), (64, 16)):
+        monkeypatch.setattr(_os, "cpu_count", lambda n=cpus: n)
+        assert rc.default_pool_size() == want_pool, cpus
+
+
+def test_payload_capability_pinned_and_drift_degrades_loud(monkeypatch):
+    """The jax-internal surface extract_lowering_payload rides on is pinned
+    behind a versioned capability check: the installed jax resolves to a
+    known adapter, and simulated signature drift disables extraction with
+    ONE clear diagnostic (not a silent blanket-except degradation)."""
+    import warnings
+
+    from dynamic_load_balance_distributeddnn_tpu.runtime import compile_worker as cw
+
+    cap = cw.payload_capability()
+    assert cap["available"] and cap["version"] == "v1"
+    # simulate drift: an unknown signature surface
+    monkeypatch.setattr(cw, "_payload_api_cache", {
+        "available": False, "version": None,
+        "reason": "pxla.create_compile_options signature drifted: observed "
+        "('new_arg',)",
+    })
+    monkeypatch.setattr(cw, "_payload_drift_warned", False)
+    f, spec = _make_program(2.5, width=21)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert cw.extract_lowering_payload(f.lower(*spec)) is None
+        assert cw.extract_lowering_payload(f.lower(*spec)) is None
+    drift = [x for x in w if "signature drifted" in str(x.message)]
+    assert len(drift) == 1  # loud once, then clean degradation
 
 
 def test_dead_at_spawn_pool_unblocks_waiters_fast(tmp_path):

@@ -54,6 +54,10 @@ def model_of(src: str, path: str = "m.py") -> MeshModel:
         ("g015_violation.py", "G015", 2),
         # local unequal-shard sink + interprocedural param sink
         ("g016_violation.py", "G016", 3),
+        # plan taint through self-attrs + container-element mutation
+        # (ISSUE 11 satellite: the window controller stores plan-derived
+        # sizes on `self` and packs columns into lists)
+        ("g016_attr_violation.py", "G016", 3),
     ],
 )
 def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -67,7 +71,8 @@ def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings)
 
 
 @pytest.mark.parametrize(
-    "fixture", ["g014_clean.py", "g015_clean.py", "g016_clean.py"]
+    "fixture",
+    ["g014_clean.py", "g015_clean.py", "g016_clean.py", "g016_attr_clean.py"],
 )
 def test_clean_fixture_is_quiet(fixture):
     path = str(FIXTURES / fixture)
@@ -438,6 +443,80 @@ def test_g016_taint_climbs_multi_level_call_chains():
     findings = analyze_source(src)
     assert [f.code for f in findings] == ["G016"], findings
     assert findings[0].line == 12
+
+
+def test_g016_taint_flows_through_self_attrs():
+    """ISSUE 11 satellite: a plan-derived value stored on ``self`` in one
+    method and sunk in ANOTHER method of the same class must flag — and the
+    quantized twin must stay quiet (cleanse at the attr write)."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "class Ctl:\n"
+        "    def plan(self, shares, global_batch):\n"
+        "        self._sizes = integer_batch_split(shares, global_batch)\n"
+        "    def flush(self, parts):\n"
+        "        cols = [p[:b] for p, b in zip(parts, self._sizes)]\n"
+        "        return jnp.stack(cols)\n"
+    )
+    findings = analyze_source(src)
+    assert codes(findings) == {"G016"}, findings
+    clean = src.replace(
+        "self._sizes = integer_batch_split(shares, global_batch)",
+        "self._sizes = quantize_batches(\n"
+        "            integer_batch_split(shares, global_batch), 8, global_batch)",
+    )
+    assert analyze_source(clean) == []
+
+
+def test_g016_taint_flows_through_container_mutation():
+    """``cols.append(batches)`` then ``jnp.stack(cols)`` is the same bug as
+    stacking the raw widths directly — mutation taints the receiver (local
+    containers and self-attr containers alike); appending a quantized value
+    stays quiet."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "def epoch(shares, global_batch):\n"
+        "    cols = []\n"
+        "    batches = integer_batch_split(shares, global_batch)\n"
+        "    cols.append(batches)\n"
+        "    return jnp.stack(cols)\n"
+    )
+    findings = analyze_source(src)
+    assert codes(findings) == {"G016"}, findings
+    clean = src.replace(
+        "cols.append(batches)",
+        "cols.append(quantize_batches(batches, 8, global_batch))",
+    )
+    assert analyze_source(clean) == []
+
+
+def test_g016_subscript_store_unions_container_taint():
+    """An element store into a container neither replaces nor (when clean)
+    un-taints it: ``d[0] = raw`` taints, and a later clean element store
+    must not wash the earlier taint away."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        '    return Mesh(np.array(devices), ("data",))\n'
+        "def epoch(shares, global_batch, other):\n"
+        "    cols = {}\n"
+        "    cols[0] = integer_batch_split(shares, global_batch)\n"
+        "    cols[1] = other\n"
+        "    return jnp.stack(list(cols.values()))\n"
+    )
+    findings = analyze_source(src)
+    assert codes(findings) == {"G016"}, findings
 
 
 def test_inline_suppression_silences_mesh_findings():

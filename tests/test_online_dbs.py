@@ -1,0 +1,334 @@
+"""Online DBS (ISSUE 11): window-cadence rebalancing correctness.
+
+The controller contracts under test:
+
+* **switch parity** — a mid-epoch plan switch is bitwise-equivalent to a
+  fresh run started on the new (remainder) plan from the same state: same
+  parameters, same loss accounting;
+* **no-thrash** — under the ``sin`` injection schedule the hysteresis +
+  regret budget bound the switch count (and the ledger invariant holds);
+* **zero foreground compiles** — with the AOT service on, a switch only
+  executes once its candidate executables are warm (speculation is re-aimed
+  at the controller's candidates), so steady-state epochs stay
+  compile-silent across switches;
+* controller/injector/remainder-plan units.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.guards import compile_budget
+from dynamic_load_balance_distributeddnn_tpu.balance.controller import (
+    OnlineRebalanceController,
+    step_time,
+)
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+from dynamic_load_balance_distributeddnn_tpu.data.partitioner import (
+    build_epoch_plan,
+    build_remainder_plan,
+)
+from dynamic_load_balance_distributeddnn_tpu.faults import (
+    FaultContext,
+    ScheduledStragglerInjector,
+)
+from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return synthetic_dataset("mnist", n_train=1024, n_test=256)
+
+
+def linear_time(plan):
+    return np.array([float(w.batch_size * w.steps) for w in plan.workers])
+
+
+def _cfg(**kw):
+    base = dict(
+        debug=True,
+        world_size=4,
+        batch_size=128,
+        learning_rate=0.05,
+        epoch_size=1,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=True,
+        seed=1234,
+        bucket=8,
+        device=0,  # scan mode: the contention topology
+        superstep="auto",
+        superstep_window=2,  # 4 dispatch windows per 8-step epoch
+        packed="off",
+        straggler="8,1,1,1",
+        fault_schedule="sin",
+        fault_period=1.0,
+        rebalance="window",
+        rebalance_every=1,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _trainer(bundle, cfg):
+    return Trainer(cfg, bundle=bundle, timing_model=linear_time, log_to_file=False)
+
+
+def _flat_aux(aux_acc, aux_windows):
+    out = [np.asarray(a, dtype=np.float64).reshape(-1, 4) for a in aux_windows]
+    rows = [np.asarray(a, dtype=np.float64).reshape(1, -1) for a in aux_acc]
+    return np.concatenate(rows + out, axis=0) if (rows or out) else np.zeros((0, 4))
+
+
+# --------------------------------------------------------------- units
+
+
+def test_scheduled_injector_gain_and_mean():
+    inj = ScheduledStragglerInjector([3.0, 1.0], schedule="sin", period=2.0)
+    assert inj.gain(0.0) == pytest.approx(0.0)
+    assert inj.gain(1.0) == pytest.approx(1.0)  # half period = peak
+    assert inj.factors_at(1.0)[0] == pytest.approx(3.0)
+    assert inj.factors_at(1.0)[1] == pytest.approx(1.0)
+    # epoch mean over a half period covers the rising flank: strictly
+    # between the endpoints
+    ctx = FaultContext(batch_sizes=np.array([4.0, 4.0]))
+    mean = inj.epoch_faults(0, 4, ctx).time_multipliers
+    assert 1.0 < mean[0] < 3.0
+    ramp = ScheduledStragglerInjector([2.0, 1.0], schedule="ramp", period=1.0)
+    assert ramp.gain(0.5) == pytest.approx(0.5)
+    assert ramp.gain(3.0) == pytest.approx(1.0)  # holds after the rise
+
+
+def test_scheduled_injector_compute_mode_sizes_from_instantaneous_factor():
+    inj = ScheduledStragglerInjector(
+        [3.0, 1.0], mode="compute", schedule="sin", period=2.0
+    )
+    ctx = FaultContext(
+        batch_sizes=np.array([8.0, 8.0]),
+        iter_cost_s=0.001,
+        per_example_cost_s=np.array([0.01, 0.01]),
+    )
+    peak = inj.faults_at(1.0, ctx)
+    off = inj.faults_at(0.0, ctx)
+    # (3-1) * 0.01 * 8 / 0.001 = 160 iters at the peak, none at the trough
+    assert peak.slow_iters_per_step[0] == 160
+    assert peak.slow_iters_per_step[1] == 0
+    assert off.slow_iters_per_step[0] == 0
+
+
+def test_remainder_plan_conserves_unvisited_pool():
+    plan = build_epoch_plan(
+        1024, np.full(4, 0.25), np.full(4, 32, dtype=np.int64), 128, 0,
+        seed=7, bucket=8,
+    )
+    rplan = build_remainder_plan(
+        plan, 4, np.array([8, 40, 40, 40], dtype=np.int64), bucket=8
+    )
+    assert rplan.num_steps == plan.num_steps - 4
+    pool = np.concatenate([w.indices[4 * w.batch_size:] for w in plan.workers])
+    got = np.concatenate([w.indices for w in rplan.workers])
+    # contiguous re-split of the rank-ordered unvisited pool (truncation
+    # only — no example is ever visited twice)
+    assert set(got) <= set(pool)
+    assert len(got) == len(set(got))
+    # deterministic: same inputs, same plan
+    r2 = build_remainder_plan(
+        plan, 4, np.array([8, 40, 40, 40], dtype=np.int64), bucket=8
+    )
+    for a, b in zip(rplan.workers, r2.workers):
+        np.testing.assert_array_equal(a.indices, b.indices)
+    # padded batches ride the bucket ladder
+    assert [w.padded_batch for w in rplan.workers] == [8, 40, 40, 40]
+
+
+def test_controller_hysteresis_and_budget():
+    groups = [[0], [1], [2], [3]]
+    ctl = OnlineRebalanceController(
+        4, 128, groups, bucket=8, hysteresis=0.1, margin=3.0,
+        budget_frac=0.5, cost_init=0.01,
+    )
+    b = np.full(4, 32, dtype=np.int64)
+    # uniform rates: the candidate IS the current plan
+    dec = ctl.propose(np.ones(4), b, remaining_steps=8)
+    assert not dec.switch and dec.reason == "same-plan"
+    # a strong straggler: switch passes every gate
+    dec = ctl.propose(np.array([8.0, 1, 1, 1]), b, remaining_steps=8)
+    assert dec.switch and dec.reason == "switch"
+    assert dec.predicted_win_s > 0
+    ctl.commit(dec, 0.005)
+    assert ctl.switches == 1 and ctl.spent_s == pytest.approx(0.005)
+    # a tiny imbalance: relative hysteresis blocks it even though a
+    # different quantized plan exists
+    dec2 = ctl.propose(np.array([1.12, 1, 1, 1]), b, remaining_steps=8)
+    assert not dec2.switch
+    assert dec2.reason in ("below-hysteresis", "same-plan", "below-margin")
+    # margin: with a huge measured switch cost the absolute gate blocks
+    expensive = OnlineRebalanceController(
+        4, 128, groups, bucket=8, margin=3.0, cost_init=1e9
+    )
+    dec3 = expensive.propose(np.array([8.0, 1, 1, 1]), b, remaining_steps=8)
+    assert not dec3.switch and dec3.reason == "below-margin"
+    # regret budget: an exhausted ledger blocks further switches
+    broke = OnlineRebalanceController(
+        4, 128, groups, bucket=8, margin=0.0, budget_frac=0.5, cost_init=0.0
+    )
+    broke.spent_s, broke.credit_s = 1e6, 0.0
+    dec4 = broke.propose(np.array([8.0, 1, 1, 1]), b, remaining_steps=8)
+    assert not dec4.switch and dec4.reason == "budget-exhausted"
+
+
+def test_step_time_models_device_grouping():
+    rates = np.array([1.0, 1.0, 1.0, 1.0])
+    b = np.array([32, 32, 32, 32])
+    # one worker per device: the step is the slowest worker
+    assert step_time(rates, b, [[0], [1], [2], [3]]) == pytest.approx(32.0)
+    # all on one device: workers serialize
+    assert step_time(rates, b, [[0, 1, 2, 3]]) == pytest.approx(128.0)
+
+
+# ------------------------------------------------- switch parity (bitwise)
+
+
+def test_mid_epoch_switch_parity_vs_fresh_remainder_run(bundle):
+    """ISSUE acceptance: a mid-epoch plan switch must be bitwise-equivalent
+    to a fresh run started on the new plan from the same state. Run A
+    switches live (the controller's in-epoch path); run B executes the
+    identical prefix, then — from that state — dispatches the remainder
+    plan standalone through the replay helper. Same params, same loss
+    rows."""
+    cfg = _cfg(aot_warm=False)  # no warm gate: the switch lands deterministically
+    tr_a = _trainer(bundle, cfg)
+    tr_a.run_epoch(0)
+    events = [
+        e for e in tr_a.recorder.meta.get("rebalance_events", [])
+        if e["epoch"] == 0
+    ]
+    assert events, "the sin schedule must trigger at least one switch"
+
+    tr_b = _trainer(bundle, cfg.replace(rebalance="epoch"))
+    plan_b, faults_b = tr_b._plan_epoch(0)
+    assert plan_b.batch_sizes.tolist() == [32, 32, 32, 32]
+    base_key = jax.random.PRNGKey(cfg.seed * 7919)
+    wkeys = jax.random.split(base_key, 4 * plan_b.num_steps)
+    s1 = events[0]["step"]
+    # prefix: the windows before the first switch, under the boundary plan
+    prefix = [w for w in tr_b._elastic_ranges(plan_b.num_steps) if w[1] <= s1]
+    aux_acc, aux_windows = [], []
+    tr_b._run_elastic_windows(
+        plan_b, [(0, plan_b)], prefix, wkeys, faults_b, 0, aux_acc, aux_windows
+    )
+    jax.block_until_ready(tr_b.state.params)
+    rows = [_flat_aux(aux_acc, aux_windows)]  # dispatch-order rows per call
+    # remainder: chain the recorded switches into remainder plans and run
+    # them FROM THE PREFIX STATE
+    segs = [(0, plan_b)]
+    for ev in events:
+        start, pl = segs[-1]
+        segs.append(
+            (
+                ev["step"],
+                build_remainder_plan(
+                    pl, ev["step"] - start,
+                    np.asarray(ev["batches"], dtype=np.int64),
+                    bucket=cfg.bucket,
+                ),
+            )
+        )
+    for (start, rpl), nxt in zip(segs[1:], segs[2:] + [(plan_b.num_steps, None)]):
+        if nxt[1] is None:
+            # final segment: the engine's own fresh-remainder replay helper
+            # (the reference leg the parity contract names)
+            rows.append(
+                _flat_aux(
+                    tr_b._replay_window_segment(plan_b, rpl, start, 0, faults_b),
+                    [],
+                )
+            )
+            continue
+        sub = [
+            w for w in tr_b._elastic_ranges(plan_b.num_steps)
+            if start <= w[0] and w[1] <= nxt[0]
+        ]
+        aux_acc2, aux_windows2 = [], []
+        tr_b._run_elastic_windows(
+            plan_b, [(start, rpl)], sub, wkeys, faults_b, 0,
+            aux_acc2, aux_windows2,
+        )
+        jax.block_until_ready(tr_b.state.params)
+        rows.append(_flat_aux(aux_acc2, aux_windows2))
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(tr_a.state.params),
+        jax.tree_util.tree_leaves(tr_b.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    allrows = np.concatenate(rows, axis=0)
+    loss_b = float(np.sum(allrows[:, 1])) / max(float(np.sum(allrows[:, 2])), 1.0)
+    assert loss_b == tr_a.recorder.data["train_loss"][0]
+
+
+def test_window_cadence_without_switch_matches_epoch_cadence(bundle):
+    """With a schedule too weak to pass hysteresis, rebalance=window must be
+    bitwise-identical to rebalance=epoch — the controller's evaluation path
+    (including its signal sync) must not perturb the math."""
+    quiet = dict(straggler="1.05,1,1,1", aot_warm=False, epoch_size=2)
+    tr_w = _trainer(bundle, _cfg(**quiet))
+    tr_e = _trainer(bundle, _cfg(**quiet).replace(rebalance="epoch"))
+    for e in range(2):
+        tr_w.run_epoch(e)
+        tr_e.run_epoch(e)
+    assert tr_w._rebalance_ctl is not None
+    assert tr_w._rebalance_ctl.switches == 0
+    np.testing.assert_array_equal(
+        tr_w.recorder.data["train_loss"], tr_e.recorder.data["train_loss"]
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(tr_w.state.params),
+        jax.tree_util.tree_leaves(tr_e.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------- no-thrash
+
+
+def test_no_thrash_under_sin_schedule(bundle):
+    """Bounded switching under the time-varying schedule: the hysteresis +
+    budget keep the switch count well below the evaluation count, and the
+    regret ledger invariant (spend covered by banked wins) holds."""
+    epochs = 3
+    tr = _trainer(bundle, _cfg(epoch_size=epochs, aot_warm=False))
+    for e in range(epochs):
+        tr.run_epoch(e)
+    ctl = tr._rebalance_ctl
+    assert ctl is not None and ctl.evals >= epochs
+    switches = float(np.sum(tr.recorder.data["plan_switches"]))
+    assert switches >= 1, "the schedule's swing must trigger rebalancing"
+    assert switches <= 2 * epochs, f"thrash: {switches} switches"
+    assert switches < ctl.evals
+    assert ctl.spent_s <= ctl.budget_frac * ctl.credit_s + ctl.cost_init
+    # every executed switch recorded a principled ledger entry
+    for ev in tr.recorder.meta["rebalance_events"]:
+        assert ev["predicted_win_s"] >= ctl.margin * 0  # present + numeric
+        assert ev["remaining_steps"] > 0
+
+
+# ------------------------------------- zero foreground compiles (sentinel)
+
+
+def test_switch_is_foreground_compile_silent(bundle):
+    """With the AOT service on, speculation is re-aimed at the controller's
+    candidate plans and switches are warm-gated — so epochs AFTER the warm
+    epoch stay foreground-compile-silent even across mid-epoch switches."""
+    cfg = _cfg(epoch_size=3, warm_start=True)
+    tr = _trainer(bundle, cfg)
+    tr.run_epoch(0)  # warm epoch: pays the universe (background, untimed)
+    with compile_budget(max_compiles=0, label="online_dbs_switch_epochs"):
+        tr.run_epoch(1)
+        tr.run_epoch(2)
+    total = float(np.sum(tr.recorder.data["plan_switches"]))
+    deferred = tr._rebalance_ctl.deferred
+    assert total + deferred >= 1, "no switch was ever attempted"
+    # the sentinel series agrees epoch-by-epoch
+    assert all(v == 0.0 for v in tr.recorder.data["xla_compiles"][1:])

@@ -823,6 +823,58 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
                 )
             out["instr"]["online_dbs_ab"] = ab
         _write_atomic(out_path, out)
+
+    if (
+        force_cpu
+        and os.environ.get("BENCH_GRAD_COMM_AB", "1") == "1"
+        and "grad_comm_ab" not in out["instr"]
+    ):
+        if resume.get("instr", {}).get("grad_comm_ab"):
+            out["instr"]["grad_comm_ab"] = resume["instr"]["grad_comm_ab"]
+        else:
+            # Hierarchical-vs-flat gradient-collective A/B (ISSUE 12
+            # acceptance) in a dedicated subprocess: the comm-bound leg
+            # shapes the loopback to a DCN-class rate and spans two gloo
+            # processes, which cannot share this process's already-
+            # initialized in-process backend.
+            fd, ab_path = tempfile.mkstemp(suffix=".json")
+            os.close(fd)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+            proc = None
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--grad-comm-ab", "--out", ab_path],
+                    capture_output=True,
+                    text=True,
+                    timeout=float(os.environ.get("BENCH_GRAD_COMM_AB_TIMEOUT", 900)),
+                    env=env,
+                )
+                with open(ab_path) as f:
+                    ab = json.load(f)
+                if proc.returncode == 0 and ("speedup_x" in ab or "error" in ab):
+                    out["instr"]["grad_comm_ab"] = ab
+                else:
+                    sys.stderr.write(
+                        f"[bench] grad_comm_ab incomplete "
+                        f"(rc={proc.returncode}, keys={sorted(ab)}); dropped\n"
+                    )
+            except Exception as e:
+                sys.stderr.write(f"[bench] grad_comm_ab failed: {e}\n")
+            finally:
+                # the child unshapes lo in ITS finally, but an outer-timeout
+                # SIGKILL skips finallys — never leave the fabric throttled
+                # for the rest of the round
+                _tc("qdisc", "del", "dev", "lo", "root")
+                if proc is not None and proc.returncode != 0 and proc.stderr:
+                    sys.stderr.write(proc.stderr[-800:] + "\n")
+                try:
+                    os.unlink(ab_path)
+                except OSError:
+                    pass
+        _write_atomic(out_path, out)
     return 0
 
 
@@ -1068,6 +1120,311 @@ def run_workers_ab(out_path: str) -> int:
 
 
 # --------------------------------------------------------------- orchestrator
+
+
+def _tc(*args) -> bool:
+    """Best-effort traffic-control invocation (loopback shaping for the
+    grad_comm A/B). Returns success; never raises."""
+    try:
+        return (
+            subprocess.run(
+                ["tc", *args], capture_output=True, text=True, timeout=10
+            ).returncode
+            == 0
+        )
+    except Exception:
+        return False
+
+
+def run_grad_comm_worker(proc_id: int, num_procs: int, port: int) -> int:
+    """One host of the grad_comm A/B fabric: a single-device process on the
+    gloo CPU collectives backend — every cross-process byte rides the
+    (shaped) loopback, which IS the DCN under test. Times the SHIPPED
+    combine structures on a resnet18-scale (11.2M element) gradient tree:
+
+    * flat — the fused body's per-leaf f32 psum over the whole mesh;
+    * hier — parallel/wire.py ``hier_tree_allreduce`` (the exact spine
+      StepLibrary._hier_combine dispatches): ravel once, in-host
+      reduce-scatter, ONE compressed cross-host hop, in-host all-gather —
+      at each wire format.
+
+    One chip per host is the DCN-pure profile (v5e-1-class hosts): the
+    in-host phases are identity, so the measured delta isolates the
+    compressed hop; on multi-chip hosts the reduce-scatter additionally
+    divides the hop payload by D (bytes recorded per arm by the engine's
+    comm_bytes series)."""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamic_load_balance_distributeddnn_tpu.parallel import wire as wirefmt
+    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+        hier_mesh,
+        shard_map,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.parallel.topology import (
+        factor_hosts,
+    )
+
+    devs = jax.devices()
+    hosts = factor_hosts(devs)
+    assert hosts == num_procs, (hosts, num_procs)
+    mesh = hier_mesh(devs, hosts)
+    h_ax, d_ax = mesh.axis_names
+    n_d = int(mesh.shape[d_ax])
+    bx = (h_ax, d_ax)
+
+    # resnet18-scale gradient tree: ~11.0M f32 elements (44 MB) over 19
+    # conv/dense/bn-shaped leaves — the bytes profile of the repo's
+    # standard bench model, without paying its CPU model-compile wall
+    # inside a comm microbench
+    sizes = (
+        [64 * 3 * 7 * 7]
+        + [64 * 64 * 3 * 3] * 4
+        + [64 * 128 * 3 * 3, 128 * 128 * 3 * 3, 128 * 128 * 3 * 3,
+           128 * 128 * 3 * 3]
+        + [128 * 256 * 3 * 3, 256 * 256 * 3 * 3, 256 * 256 * 3 * 3,
+           256 * 256 * 3 * 3]
+        + [256 * 512 * 3 * 3, 512 * 512 * 3 * 3, 512 * 512 * 3 * 3,
+           512 * 512 * 3 * 3]
+        + [512 * 10, 512, 512]
+    )
+    rng = np.random.RandomState(7)
+    sh = NamedSharding(mesh, P(bx))
+    stacked = [
+        jax.make_array_from_process_local_data(
+            sh, rng.standard_normal((1, s)).astype(np.float32)
+        )
+        for s in sizes
+    ]
+    n_elems = int(sum(sizes))
+
+    def flat_body(*st):
+        # the shipped flat combine's collective pattern: per-leaf f32 psum
+        return tuple(
+            jax.lax.psum(jnp.sum(g, axis=0), (h_ax, d_ax)) for g in st
+        )
+
+    def hier_body_of(wire):
+        def hier_body(*st):
+            local = [jnp.sum(g, axis=0) for g in st]
+            out, _res = wirefmt.hier_tree_allreduce(
+                local, jax.random.PRNGKey(3), h_ax, d_ax, hosts, n_d, wire
+            )
+            return tuple(out)
+
+        return hier_body
+
+    in_sp = tuple(P(bx) for _ in stacked)
+    out_sp = tuple(P() for _ in stacked)
+    reps = int(os.environ.get("BENCH_GRAD_COMM_REPS", 4))
+
+    def timed(body):
+        fn = jax.jit(
+            shard_map(
+                body, mesh=mesh, in_specs=in_sp, out_specs=out_sp,
+                check_vma=False,
+            )
+        )
+        jax.block_until_ready(fn(*stacked))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*stacked))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    res = {"flat_wall_s": round(timed(flat_body), 4)}
+    for wire in ("fp32", "int8", "int4"):
+        res[f"hier_{wire}_wall_s"] = round(timed(hier_body_of(wire)), 4)
+    res["tree_elems"] = n_elems
+    res["tree_leaves"] = len(sizes)
+    if proc_id == 0:
+        print("RESULT " + json.dumps(res), flush=True)
+    return 0
+
+
+def run_grad_comm_ab(out_path: str) -> int:
+    """Hierarchical-vs-flat gradient-collective A/B (ISSUE 12 acceptance
+    field ``grad_comm_ab``), in a dedicated subprocess tree.
+
+    Leg 1 (parity, in-process 8-device 2x4 mesh): integer-valued gradients
+    sum EXACTLY in f32 under any grouping, so the fp32-wire hier spine must
+    be bit-for-bit one flat psum — ``parity_fp32_bitwise``.
+
+    Leg 2 (the comm-bound wall): the loopback is shaped to a DCN-class
+    bandwidth (tbf, BENCH_GRAD_COMM_RATE_MBIT, default 200) and two
+    single-device gloo processes — every cross-host byte on the shaped
+    link, the profile where the flat combine IS the epoch wall — time the
+    shipped flat and hier combines on a resnet18-scale tree.
+    ``speedup_x`` = flat / hier at the default int8 wire. The shaping is
+    removed in a finally (and pre-cleaned at entry, so a killed previous
+    run cannot leave the fabric throttled — the run_arms caller also
+    best-effort-unshapes after this subprocess exits, covering a SIGKILL
+    that skips the finally). No tc available -> the leg is skipped with an
+    explicit marker (parity still reported)."""
+    done = _install_init_watchdog()
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamic_load_balance_distributeddnn_tpu.parallel import wire as wirefmt
+    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+        hier_mesh,
+        shard_map,
+    )
+
+    ab = {}
+    done.set()
+
+    # ---- leg 1: bitwise fp32 parity on the in-process 2x4 mesh ----
+    mesh = hier_mesh(jax.devices(), 2)
+    h_ax, d_ax = mesh.axis_names
+    bx = (h_ax, d_ax)
+    n = len(jax.devices())
+    vals = np.random.RandomState(0).randint(-64, 64, size=(n, 4099)).astype(
+        np.float32
+    )
+    x = jax.device_put(vals, NamedSharding(mesh, P(bx)))
+
+    def hier_body(v):
+        out, _res = wirefmt.hier_tree_allreduce(
+            [v[0]], jax.random.PRNGKey(0), h_ax, d_ax,
+            int(mesh.shape[h_ax]), int(mesh.shape[d_ax]), "fp32",
+        )
+        return out[0][None]
+
+    def flat_body(v):
+        return jax.lax.psum(v, (h_ax, d_ax))
+
+    hier_fn = jax.jit(
+        shard_map(hier_body, mesh=mesh, in_specs=P(bx), out_specs=P(bx),
+                  check_vma=False)
+    )
+    flat_fn = jax.jit(
+        shard_map(flat_body, mesh=mesh, in_specs=P(bx), out_specs=P(bx),
+                  check_vma=False)
+    )
+    out_h = np.asarray(hier_fn(x))[0]
+    out_f = np.asarray(flat_fn(x))[0]
+    ab["parity_fp32_bitwise"] = bool(
+        np.array_equal(out_h, out_f) and np.array_equal(out_h, vals.sum(axis=0))
+    )
+    _write_atomic(out_path, ab)
+
+    # ---- leg 2: shaped-DCN wall A/B across two gloo processes ----
+    # DCN-class ceiling for the shaped loopback. 200 mbit keeps the leg
+    # firmly bandwidth-bound: at 400+ the per-op fixed costs (gloo
+    # chunking, the monolithic raveled transfer vs the flat arm's
+    # pipelined per-leaf ops) eat most of the compressed wire's margin
+    rate = int(os.environ.get("BENCH_GRAD_COMM_RATE_MBIT", 200))
+    ab["dcn_rate_mbit"] = rate
+    _tc("qdisc", "del", "dev", "lo", "root")  # pre-clean a stale qdisc
+    # generous burst/queue: an undersized tbf queue DROPS past the burst
+    # and TCP's loss response collapses throughput unevenly across arms —
+    # the A/B wants a clean bandwidth ceiling, not a lossy link
+    shaped = _tc(
+        "qdisc", "add", "dev", "lo", "root", "tbf",
+        "rate", f"{rate}mbit", "burst", "1mb", "latency", "800ms",
+    )
+    if not shaped:
+        ab["error"] = "tc/tbf unavailable: cannot shape a DCN-class link"
+        _write_atomic(out_path, ab)
+        return 0
+    try:
+        import socket
+
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        procs = [
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--grad-comm-worker", str(i), "2", str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env,
+            )
+            for i in range(2)
+        ]
+        try:
+            outs = [
+                p.communicate(
+                    timeout=float(
+                        os.environ.get("BENCH_GRAD_COMM_TIMEOUT", 600)
+                    )
+                )
+                for p in procs
+            ]
+        finally:
+            # a hung gloo rendezvous/collective must not leave two workers
+            # contending with every later timed arm (and pinning the port)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        line = next(
+            (
+                ln
+                for o, _e in outs
+                for ln in o.splitlines()
+                if ln.startswith("RESULT ")
+            ),
+            None,
+        )
+        if line is None or any(p.returncode != 0 for p in procs):
+            ab["error"] = (
+                f"worker rcs {[p.returncode for p in procs]}; no RESULT line"
+            )
+            sys.stderr.write(outs[0][1][-800:] + "\n")
+        else:
+            ab.update(json.loads(line[len("RESULT "):]))
+            # bytes each arm puts on the shaped DCN per combine (2 hosts,
+            # 1 device/host: the full tree crosses; the hier hop rides the
+            # wire's sum dtype) — the engine records the same accounting
+            # per epoch as comm_bytes_ici/comm_bytes_dcn
+            elems = ab["tree_elems"]
+            ab["flat_dcn_bytes"] = elems * 4
+            for wire in ("fp32", "int8", "int4"):
+                ab[f"hier_{wire}_dcn_bytes"] = (
+                    elems * wirefmt.wire_payload_bytes(wire, 2)
+                )
+            if ab.get("hier_int8_wall_s"):
+                ab["speedup_x"] = round(
+                    ab["flat_wall_s"] / ab["hier_int8_wall_s"], 3
+                )
+                ab["speedup_int4_x"] = round(
+                    ab["flat_wall_s"] / ab["hier_int4_wall_s"], 3
+                )
+                # the structure-only (fp32) ratio on a symmetric-per-hop
+                # fabric shows WHY the gating probe exists: without a
+                # compressed wire the extra hops can lose
+                ab["speedup_fp32_x"] = round(
+                    ab["flat_wall_s"] / ab["hier_fp32_wall_s"], 3
+                )
+    except Exception as e:  # noqa: BLE001 — the A/B must never leave lo shaped
+        ab["error"] = repr(e)
+    finally:
+        if not _tc("qdisc", "del", "dev", "lo", "root"):
+            sys.stderr.write("[bench] WARNING: failed to unshape lo\n")
+    _write_atomic(out_path, ab)
+    return 0
 
 
 def _steady(walls_off, walls_on):
@@ -1536,6 +1893,13 @@ def main() -> int:
         return run_aot_ab(sys.argv[sys.argv.index("--out") + 1])
     if "--workers-ab" in sys.argv:
         return run_workers_ab(sys.argv[sys.argv.index("--out") + 1])
+    if "--grad-comm-ab" in sys.argv:
+        return run_grad_comm_ab(sys.argv[sys.argv.index("--out") + 1])
+    if "--grad-comm-worker" in sys.argv:
+        i = sys.argv.index("--grad-comm-worker")
+        return run_grad_comm_worker(
+            int(sys.argv[i + 1]), int(sys.argv[i + 2]), int(sys.argv[i + 3])
+        )
     if "--arms" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
         resume = (

@@ -300,7 +300,20 @@ class CallGraph:
                 env[fqn] = frozenset()
             else:
                 env[fqn] = None
-        for _ in range(6):
+        # Greatest-fixpoint iteration: a caller whose env is still unknown
+        # (None = ⊤) is SKIPPED rather than poisoning the intersection —
+        # that is what lets lock facts flow through RECURSION CYCLES
+        # (f → g → f): every member of a cycle has at least one in-cycle
+        # caller that starts unknown, so the old "any unknown caller ⇒
+        # unknown" rule pinned whole cycles at ⊤ forever and the final
+        # coercion read them as "no locks held" (false G012 material).
+        # Treating unknowns as ⊤ is the standard optimistic start for an
+        # intersection lattice: envs only shrink as callers resolve, so the
+        # iteration is monotone and converges to the greatest fixpoint —
+        # exactly "locks held on EVERY external path into the cycle".
+        # Bound: each round can only remove lock names, so rounds are
+        # bounded by the longest chain; keep a generous cap.
+        for _ in range(max(6, len(order))):
             changed = False
             for fqn in order:
                 if fqn in spawn_targets:
@@ -309,8 +322,7 @@ class CallGraph:
                 for e in self.callers.get(fqn, ()):
                     caller_env = env.get(e.caller)
                     if caller_env is None:
-                        incoming = None
-                        break
+                        continue  # ⊤ caller: identity for intersection
                     site = frozenset(
                         t.split(".", 1)[1]
                         for t in e.call.locks
@@ -318,10 +330,9 @@ class CallGraph:
                     )
                     here = caller_env | site
                     incoming = here if incoming is None else (incoming & here)
-                else:
-                    if incoming is not None and incoming != env.get(fqn):
-                        env[fqn] = incoming
-                        changed = True
+                if incoming is not None and incoming != env.get(fqn):
+                    env[fqn] = incoming
+                    changed = True
             if not changed:
                 break
         for fqn in order:

@@ -106,6 +106,10 @@ MESH_ATTRS = {"mesh", "_mesh"}
 GEN_MARKERS = {"_aot_gen", "aot_gen", "generation"}
 RESHARD_MARKERS = ("reshard", "_reshard")
 _REGISTER_TAILS = {"submit", "compile_now"}
+# registry-surface calls whose TUPLE-literal arguments carry executable-key
+# kinds ("fused", "combine_update", ...) — the per-executable-key channel
+# G015's registered-lowering matching narrows by
+_KEY_CALL_TAILS = {"submit", "compile_now", "get", "has"}
 
 # mesh-construction helper whose axis parameter name the resolver chases
 _MESH_HELPER_AXIS_PARAM = {
@@ -332,13 +336,29 @@ class MeshModel:
         self.axis_universe: Set[str] = set()
         self.axis_universe_complete = True
         self.class_mesh_axes: Dict[Tuple[str, str], Set[str]] = {}
+        # params of each function that feed a mesh construction's axis
+        # entries ("$param" entries of a mesh-kind ctor) — the channel a
+        # CALL-SITE literal override of a defaulted axis param flows
+        # through (``build(devs, axis="model")`` defines axis "model" even
+        # though build's own ctor resolves to its default)
+        self.axis_params: Dict[str, Set[str]] = {}
         for fqn, fn in self.functions.items():
             for stmt in fn.stmts:
                 for spec in self._stmt_specs(stmt):
                     if spec.kind != "mesh":
                         continue
+                    for e in spec.axes:
+                        if (
+                            e
+                            and e.startswith("$")
+                            and "." not in e
+                            and e[1:] in fn.params
+                        ):
+                            self.axis_params.setdefault(fqn, set()).add(e[1:])
                     axes = self.spec_axes(spec, fn)
                     if axes is None:
+                        # a "$param" entry with a resolvable default stays
+                        # resolvable; anything else is genuinely dynamic
                         self.axis_universe_complete = False
                         continue
                     concrete = {a for a in axes if a}
@@ -357,19 +377,37 @@ class MeshModel:
                         self.class_mesh_axes.setdefault(
                             (fn.module, fn.cls), set()
                         ).update(concrete)
-        # mesh-returning functions (data_mesh itself, wrappers)
+        # mesh-returning functions (data_mesh itself, wrappers). Alongside
+        # the default-resolved axes, keep the RAW ctor entries of direct
+        # returns ("$axis" markers) so a call site's literal override can
+        # substitute into the right positions (edge_mesh_axes).
         self.mesh_returns: Dict[str, FrozenSet[str]] = {}
+        self._mesh_return_raw: Dict[str, Optional[Tuple[Optional[str], ...]]] = {}
         for _ in range(4):
             changed = False
             for fqn, fn in self.functions.items():
                 if fqn in self.mesh_returns:
                     continue
-                axes = self._local_mesh_return(fn)
-                if axes is not None:
+                got = self._local_mesh_return(fn)
+                if got is not None:
+                    axes, raw = got
                     self.mesh_returns[fqn] = axes
+                    self._mesh_return_raw[fqn] = raw
                     changed = True
             if not changed:
                 break
+        # Call-site literal overrides of defaulted axis params extend the
+        # axis universe: ``build(devs, axis="model")`` constructs a mesh
+        # whose axis the callee's own summary resolves to its DEFAULT —
+        # without this pass the override axis reads as undefined and every
+        # collective over it is a false G014.
+        for fqn, fn in self.functions.items():
+            for e in self.graph.edges.get(fqn, ()):
+                for val in self._axis_literal_overrides(e).values():
+                    entries = val if isinstance(val, tuple) else (val,)
+                    self.axis_universe |= {
+                        a for a in entries if isinstance(a, str)
+                    }
         # mesh-typed params: union over resolved call sites (the lattice
         # join — a param's axes are every mesh a caller may pass)
         self.param_mesh_axes: Dict[Tuple[str, str], Set[str]] = {}
@@ -424,9 +462,65 @@ class MeshModel:
                 if s is not None:
                     yield s
 
-    def _local_mesh_return(self, fn: FunctionSummary) -> Optional[FrozenSet[str]]:
+    def _axis_literal_overrides(self, e) -> Dict[str, object]:
+        """Literal axis strings (or string tuples) a call site passes for the
+        callee's axis-feeding params — the override channel that makes
+        ``build(devs, axis="model")`` define axis "model"."""
+        params = self.axis_params.get(e.callee, set())
+        if not params:
+            return {}
+        callee = self.functions.get(e.callee)
+        if callee is None:
+            return {}
+        out: Dict[str, object] = {}
+
+        def ok(v) -> bool:
+            return isinstance(v, str) or (
+                isinstance(v, tuple) and all(isinstance(x, str) for x in v)
+            )
+
+        for p in params:
+            pos = callee.params.index(p) - e.param_offset
+            if 0 <= pos < len(e.call.lit_args) and ok(e.call.lit_args[pos]):
+                out[p] = e.call.lit_args[pos]
+        for k, v in e.call.lit_kwargs:
+            if k in params and ok(v):
+                out[k] = v
+        return out
+
+    def edge_mesh_axes(self, e) -> Optional[Set[str]]:
+        """Axes of the mesh ``e.callee`` returns AT THIS CALL SITE: the
+        default-resolved set, with literal overrides substituted into the
+        "$param" positions of the callee's raw ctor entries."""
+        base = self.mesh_returns.get(e.callee)
+        if base is None:
+            return None
+        overrides = self._axis_literal_overrides(e)
+        raw = self._mesh_return_raw.get(e.callee)
+        callee = self.functions.get(e.callee)
+        if not overrides or raw is None or callee is None:
+            return set(base)
+        out: Set[str] = set()
+        for entry in raw:
+            if entry and entry.startswith("$") and entry[1:] in overrides:
+                val = overrides[entry[1:]]
+                out.update(val if isinstance(val, tuple) else (val,))
+            else:
+                r = self.resolve_axis_entry(entry, callee)
+                if r:
+                    out.add(r)
+        return out
+
+    def _local_mesh_return(
+        self, fn: FunctionSummary
+    ) -> Optional[
+        Tuple[FrozenSet[str], Optional[Tuple[Optional[str], ...]]]
+    ]:
+        """(default-resolved return axes, raw ctor entries of a DIRECT
+        construction — None for values obtained through other helpers)."""
         edge_by_line = self.edges_by_line(Project.fqn(fn))
         local: Dict[str, FrozenSet[str]] = {}
+        local_raw: Dict[str, Optional[Tuple[Optional[str], ...]]] = {}
         for stmt in fn.stmts:
             bind = stmt.bind
             if bind is not None:
@@ -435,21 +529,29 @@ class MeshModel:
                     if axes is not None:
                         for t in bind.targets:
                             local[t] = frozenset(a for a in axes if a)
+                            local_raw[t] = tuple(bind.spec.axes)
                 elif bind.rhs_call_tail:
                     # m = make_mesh(...): chase the wrapper chain — this is
                     # what lets the fixpoint grow past direct constructions
+                    # (call-site overrides applied, so a wrapper's wrapper
+                    # sees the overridden axes)
                     e = edge_by_line.get((bind.rhs_call_tail, bind.line))
                     if e is not None and e.callee in self.mesh_returns:
+                        axes2 = self.edge_mesh_axes(e)
                         for t in bind.targets:
-                            local[t] = self.mesh_returns[e.callee]
+                            local[t] = frozenset(axes2 or ())
+                            local_raw[t] = None
             if stmt.ret is not None:
                 if stmt.ret.spec is not None and stmt.ret.spec.kind == "mesh":
                     axes = self.spec_axes(stmt.ret.spec, fn)
                     if axes is not None:
-                        return frozenset(a for a in axes if a)
+                        return (
+                            frozenset(a for a in axes if a),
+                            tuple(stmt.ret.spec.axes),
+                        )
                 for tok in stmt.ret.alias_tokens:
                     if tok in local:
-                        return local[tok]
+                        return local[tok], local_raw.get(tok)
         return None
 
     def mesh_axes_of_token(
@@ -484,7 +586,7 @@ class MeshModel:
             elif bind.rhs_call_tail:
                 e = edge_by_line.get((bind.rhs_call_tail, bind.line))
                 if e is not None and e.callee in self.mesh_returns:
-                    axes = set(self.mesh_returns[e.callee])
+                    axes = set(self.edge_mesh_axes(e) or ())
                 else:
                     axes = set()
             else:
@@ -515,8 +617,7 @@ class MeshModel:
             sites: List[Tuple[str, int, int, str]] = []
             for stmt in fn.stmts:
                 for call in stmt.calls:
-                    axis = self._call_axis(call, fn)
-                    if axis is not None:
+                    for axis in self._call_axes(call, fn):
                         req.add(axis)
                         sites.append((axis, call.line, call.col, call.tail))
             self.required_axes[fqn] = req
@@ -532,28 +633,38 @@ class MeshModel:
             if not changed:
                 break
 
-    def _call_axis(
+    def _call_axes(
         self, call: CallFact, fn: FunctionSummary
-    ) -> Optional[str]:
+    ) -> List[str]:
+        """Concrete axis names one collective call consumes — possibly
+        several: a tuple-literal axis argument (``psum(x, ("host",
+        "device"))``, the two-level combine's spelling) demands every member
+        axis. Empty when the argument is opaque (errs quiet)."""
         idx = COLLECTIVE_AXIS_ARGS.get(call.tail)
         if idx is None:
-            return None
-        entry: Optional[str] = None
-        if idx < len(call.lit_args) and isinstance(call.lit_args[idx], str):
-            entry = call.lit_args[idx]
+            return []
+        entries: List[str] = []
+        lit = call.lit_args[idx] if idx < len(call.lit_args) else None
+        if isinstance(lit, str):
+            entries = [lit]
+        elif isinstance(lit, tuple) and all(isinstance(a, str) for a in lit):
+            entries = list(lit)
         elif idx < len(call.args) and call.args[idx]:
-            entry = f"${call.args[idx]}"
+            entries = [f"${call.args[idx]}"]
         else:
             for k, v in call.lit_kwargs:
                 if k in _AXIS_KWARGS and isinstance(v, str):
-                    entry = v
-            if entry is None:
+                    entries = [v]
+            if not entries:
                 for k, v in call.kwargs:
                     if k in _AXIS_KWARGS and v:
-                        entry = f"${v}"
-        if entry is None:
-            return None
-        return self.resolve_axis_entry(entry, fn)
+                        entries = [f"${v}"]
+        out = []
+        for e in entries:
+            r = self.resolve_axis_entry(e, fn)
+            if r is not None:
+                out.append(r)
+        return out
 
     # ------------------------------------------------------- spec value env
 
@@ -983,12 +1094,41 @@ class RuleG015:
 
     # -- (ii) registered lowering specs vs dispatch placements --------------
 
+    @staticmethod
+    def _key_literals(fns) -> Set[str]:
+        """Executable-key literals a scope references: string members of
+        TUPLE literals handed to registry calls (``submit(("fused", 0),
+        ...)`` / ``get(("fused", epoch))``) plus bare string key arguments.
+        Only registry-call arguments count — arbitrary string literals
+        (span names, log fragments) must never alias two scopes together."""
+        out: Set[str] = set()
+        for fn in fns:
+            for stmt in fn.stmts:
+                for call in stmt.calls:
+                    if call.tail not in _KEY_CALL_TAILS:
+                        continue
+                    for v in call.lit_args:
+                        if isinstance(v, tuple):
+                            out |= {x for x in v if isinstance(x, str)}
+                        elif isinstance(v, str):
+                            out.add(v)
+                    for _k, v in call.lit_kwargs:
+                        if isinstance(v, tuple):
+                            out |= {x for x in v if isinstance(x, str)}
+        return out
+
     def _check_registered_dispatch(
         self, ctx, model: MeshModel
     ) -> Iterator["Finding"]:
-        # per class: the spec identities its AOT-registration methods lower
-        # under, and every placement identity its dispatch methods use
-        registered: Dict[Tuple[str, str], Set[SpecId]] = {}
+        # Per class, per REGISTRATION SCOPE: the spec identities each
+        # AOT-registration method lowers under, tagged with the
+        # executable-key literals it registers. A dispatch site that
+        # resolves a specific key kind is checked against THAT scope's
+        # specs (plus any scope with no extractable key — the errs-quiet
+        # bucket); class-scoped matching let a spec registered for
+        # executable A sanction a mismatched placement dispatched to
+        # executable B (the PR-12 satellite).
+        registered: Dict[Tuple[str, str], List[Tuple[Set[str], Set[SpecId]]]] = {}
         register_fns: Dict[Tuple[str, str], Set[str]] = {}
         for fqn, fn in ctx.project.functions.items():
             if not fn.cls:
@@ -1037,7 +1177,9 @@ class RuleG015:
                             ids.add(info[0])
             if ids:
                 key = (fn.module, fn.cls)
-                registered.setdefault(key, set()).update(ids)
+                registered.setdefault(key, []).append(
+                    (self._key_literals(scope), ids)
+                )
                 register_fns.setdefault(key, set()).update(
                     Project.fqn(m) for m in scope
                 )
@@ -1049,7 +1191,25 @@ class RuleG015:
                 continue
             if fqn in register_fns.get(key, set()):
                 continue  # the registration side defines the set
-            reg = registered[key]
+            scopes = registered[key]
+            # per-executable-key narrowing: a dispatch method that resolves
+            # a literal key kind checks against the scopes registering that
+            # kind (plus key-less scopes); no extractable key on either
+            # side falls back to the class-wide union — strictly the old
+            # behavior, so precision only ever increases
+            dispatch_keys = self._key_literals((fn,))
+            matched = [
+                ids
+                for lits, ids in scopes
+                if not lits or (dispatch_keys and lits & dispatch_keys)
+            ]
+            if not dispatch_keys or not any(
+                lits and (lits & dispatch_keys) for lits, _ in scopes
+            ):
+                matched = [ids for _lits, ids in scopes]
+            reg: Set[SpecId] = set()
+            for ids in matched:
+                reg |= ids
             for stmt in fn.stmts:
                 for call in stmt.calls:
                     spec_pos = PLACEMENT_SPEC_ARG.get(call.tail)
@@ -1068,8 +1228,10 @@ class RuleG015:
                         call.line,
                         call.col,
                         f"`{call.tail}` places a dispatch operand under "
-                        f"spec {sid} but this class's AOT lowerings "
-                        f"registered only {sorted(reg)} — a committed "
+                        f"spec {sid} but the AOT lowerings registered for "
+                        f"this dispatch's executable key"
+                        f"{' kinds ' + str(sorted(dispatch_keys)) if dispatch_keys else 's'} "
+                        f"carry only {sorted(reg)} — a committed "
                         "operand sharding the executable was not lowered "
                         "for (the fused-lowering vs dispatch-seed "
                         "mismatch)",

@@ -188,6 +188,44 @@ class Config:
                                        # steps. Trades <= capacity_factor x
                                        # padding FLOPs for zero dispatch.
                                        # Needs one worker per chip.
+    grad_comm: str = "flat"            # "flat": one psum over the whole data
+                                       # mesh (the reference structure).
+                                       # "hier": two-level ICI/DCN collective
+                                       # (ISSUE 12) — full-precision in-host
+                                       # reduce-scatter, ONE compressed
+                                       # all-reduce hop across hosts on
+                                       # grad_comm_wire (error-feedback
+                                       # residuals in the TrainState), then
+                                       # an in-host all-gather. Needs a
+                                       # (host, device) factorization: real
+                                       # multi-host processes, or a
+                                       # synthetic --hier_hosts split on CPU
+                                       # tiers; falls back to flat (one log
+                                       # line) when none exists.
+    grad_comm_wire: str = "int8"       # hier DCN hop wire format
+                                       # (parallel/wire.py): "fp32" = exact
+                                       # (structure-only win), "int8" = 127
+                                       # levels, stochastic rounding
+                                       # (unbiased), int16 wire sum — half
+                                       # the f32 bytes on 1/D of the tree;
+                                       # "int4" = 7 levels, round-to-nearest
+                                       # (biased; the error-feedback
+                                       # residual makes it convergent),
+                                       # int8 wire sum — a quarter.
+    dcn_bandwidth_probe: bool = False  # measure both link classes at init
+                                       # (parallel/mesh.py
+                                       # probe_link_bandwidth) and fall back
+                                       # to the flat combine when the
+                                       # three-phase hier structure does not
+                                       # beat one flat psum on this fabric
+                                       # (single-host meshes, symmetric
+                                       # links). Off = trust --grad_comm.
+    hier_hosts: int = 0                # synthetic host-axis size for
+                                       # single-process meshes (CPU tiers,
+                                       # tests, the grad_comm bench): split
+                                       # the n devices into this many "host"
+                                       # groups. 0 = derive from the real
+                                       # process topology.
     compress_grads: str = ""           # "int8": gradient collective quantized
                                        # to 127 levels (shared pmax scale,
                                        # stochastic rounding — unbiased, no
@@ -471,6 +509,36 @@ class Config:
             raise ValueError("straggler factor list length must equal world_size")
         if self.compress_grads not in ("", "int8"):
             raise ValueError("compress_grads must be '' or 'int8'")
+        if self.grad_comm not in ("flat", "hier"):
+            raise ValueError("grad_comm must be 'flat' or 'hier'")
+        if self.grad_comm_wire not in ("fp32", "int8", "int4"):
+            raise ValueError("grad_comm_wire must be 'fp32', 'int8' or 'int4'")
+        if self.hier_hosts < 0:
+            raise ValueError("hier_hosts must be >= 0 (0 = real topology)")
+        if self.grad_comm == "hier" and self.compress_grads:
+            raise ValueError(
+                "grad_comm=hier subsumes compress_grads: the cross-host hop "
+                "already rides --grad_comm_wire (the flat int8 collective "
+                "stays available via compress_grads with grad_comm=flat)"
+            )
+        if self.grad_comm == "hier" and self.shard_update:
+            raise ValueError(
+                "grad_comm=hier and shard_update are not composed yet: the "
+                "ZeRO-1 reduce_scatter must learn to ride the quantized "
+                "wire (tracked in ROADMAP)"
+            )
+        if self.grad_comm == "hier" and self.elastic == "on":
+            raise ValueError(
+                "grad_comm=hier's two-level mesh cannot survive an elastic "
+                "re-shard yet (the survivor fleet may not re-factor into "
+                "equal host groups); run elastic fleets on the flat combine"
+            )
+        if self.grad_comm == "hier" and self.seq_parallel:
+            raise ValueError(
+                "grad_comm=hier applies to the data-parallel gradient "
+                "combine; the sequence-parallel modes shard the sequence "
+                "axis instead"
+            )
         if self.device_cache not in ("auto", "on", "off"):
             raise ValueError("device_cache must be 'auto', 'on' or 'off'")
         if self.packed not in ("auto", "on", "off"):
@@ -627,6 +695,26 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused_dbs", type=str2bool, default=d.fused_dbs,
                    help="DBS on the fused capacity-padded SPMD scan (one "
                         "compiled step for every plan; probe-measured times).")
+    p.add_argument("--grad_comm", type=str, default=d.grad_comm,
+                   choices=["flat", "hier"],
+                   help="Gradient combine structure: flat single psum, or "
+                        "the hierarchical ICI/DCN collective (in-host "
+                        "reduce-scatter, compressed cross-host hop with "
+                        "error-feedback residuals, in-host all-gather).")
+    p.add_argument("--grad_comm_wire", type=str, default=d.grad_comm_wire,
+                   choices=["fp32", "int8", "int4"],
+                   help="Wire format of the hierarchical cross-host hop: "
+                        "fp32 exact, int8 stochastic-rounded (unbiased, "
+                        "int16 wire sum), int4 nearest-rounded (biased, "
+                        "error feedback corrects; int8 wire sum).")
+    p.add_argument("--dcn_bandwidth_probe", type=str2bool,
+                   default=d.dcn_bandwidth_probe,
+                   help="Probe both link classes at init and fall back to "
+                        "the flat combine when the hierarchical structure "
+                        "does not beat one flat psum on this fabric.")
+    p.add_argument("--hier_hosts", type=int, default=d.hier_hosts,
+                   help="Synthetic host-axis size for single-process meshes "
+                        "(CPU tiers/tests); 0 = real process topology.")
     p.add_argument("--compress_grads", type=str, default=d.compress_grads,
                    choices=["", "int8"],
                    help="Quantized gradient collective (stochastic rounding, "

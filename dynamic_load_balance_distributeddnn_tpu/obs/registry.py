@@ -79,6 +79,17 @@ class MetricsRegistry:
                 "events": len(self.tracer.events()) if self.tracer.enabled else 0,
             },
         }
+        # gradient-collective wire accounting (ISSUE 12): per-epoch bytes
+        # each link class carried, plus the combine structure they were
+        # measured under — the grad_comm bench reads these per arm
+        comm = {
+            k: self.recorder.last(k)
+            for k in ("comm_bytes_ici", "comm_bytes_dcn")
+            if self.recorder.last(k) is not None
+        }
+        if comm:
+            comm["grad_comm"] = self.recorder.meta.get("grad_comm", "flat")
+            out["comm"] = comm
         if self.host_meter is not None:
             m = self.host_meter
             out["host"] = {

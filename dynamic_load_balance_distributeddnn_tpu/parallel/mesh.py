@@ -15,13 +15,21 @@ reshaping the core.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence, Union
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+
+# Two-level ICI/DCN factorization (ISSUE 12): the flat data axis splits into
+# an in-host axis (chips wired by ICI — fast) and a cross-host axis (DCN —
+# the slow link on pods). The hierarchical gradient collective
+# reduce-scatters over DEVICE_AXIS at full precision, crosses HOST_AXIS on a
+# compressed wire, and all-gathers back over DEVICE_AXIS.
+HOST_AXIS = "host"
+DEVICE_AXIS = "device"
 
 
 def _resolve_shard_map():
@@ -81,6 +89,133 @@ def initialize_multihost(coordinator: Optional[str] = None, **kw) -> None:
 def data_mesh(devices: Optional[Sequence] = None, axis: str = DATA_AXIS) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devices), (axis,))
+
+
+def hier_mesh(
+    devices: Sequence,
+    hosts: int,
+    host_axis: str = HOST_AXIS,
+    device_axis: str = DEVICE_AXIS,
+) -> Mesh:
+    """Two-level ``(host, device)`` mesh over a flat device list: row k holds
+    host k's chips (the list must already be host-grouped in mesh order —
+    parallel/topology.py ``factor_hosts`` validates exactly that). Device
+    order is row-major, so position ``h*D + d`` matches the flat
+    :func:`data_mesh` order and per-device work (rng folds, batch slices) is
+    identical under either factorization."""
+    devices = list(devices)
+    if hosts < 1 or len(devices) % hosts:
+        raise ValueError(
+            f"{len(devices)} devices do not factor into {hosts} hosts"
+        )
+    arr = np.array(devices).reshape(hosts, len(devices) // hosts)
+    return Mesh(arr, (host_axis, device_axis))
+
+
+def mesh_batch_axes(mesh: Mesh) -> Union[str, tuple]:
+    """The PartitionSpec entry that shards a batch dimension over the WHOLE
+    mesh: the lone axis name on a flat mesh, the axis-name tuple on a
+    two-level one (P treats a tuple entry as that dim split over all named
+    axes, major-to-minor — the flat device order)."""
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
+
+
+def probe_link_bandwidth(
+    mesh: Mesh, floats_per_device: int = 1 << 18, reps: int = 3, tracer=None
+) -> Dict[str, object]:
+    """Tiny per-link bandwidth probe of a two-level mesh (ISSUE 12): time the
+    three phases of the hierarchical combine standalone — a full-precision
+    reduce-scatter over DEVICE_AXIS (ICI), a psum over HOST_AXIS on the
+    scattered chunk (the DCN hop), and the all-gather back — and derive
+    bytes/s per link class from the logical per-device payload. The engine
+    gates ``--grad_comm hier`` on the ratio when ``--dcn_bandwidth_probe`` is
+    set (a mesh whose "DCN" is as fast as its ICI — one host, or a CPU test
+    mesh — gains nothing from the extra hops and falls back to flat).
+
+    Each phase runs under its own graftscope span (``comm_reduce_scatter`` /
+    ``comm_dcn`` / ``comm_gather``, cat="comm") so a traced run shows the
+    per-link attribution directly."""
+    import time
+
+    import jax.numpy as jnp
+
+    if tracer is None:
+        from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
+
+        tracer = get_tracer()
+    h_ax, d_ax = mesh.axis_names
+    n_h, n_d = mesh.shape[h_ax], mesh.shape[d_ax]
+    n = n_h * n_d
+    c = -(-floats_per_device // n_d) * n_d  # per-device payload, RS-divisible
+    both = (h_ax, d_ax)
+    sh = NamedSharding(mesh, P(both))
+
+    def _program(body):
+        # one-shot probe wrappers, built once per PROBE (at most once per
+        # engine init, never in a hot scope) — caching them would pin the
+        # mesh alive for the life of the process
+        return jax.jit(  # graftlint: disable=G001
+            shard_map(
+                body, mesh=mesh, in_specs=P(both), out_specs=P(both),
+                check_vma=False,
+            )
+        )
+
+    def _payload(size):
+        return jax.device_put(np.zeros((size,), np.float32), sh)
+
+    # two inputs serve all four programs — the full payload (RS and the
+    # flat reference) and the post-RS chunk (c/D floats per device; the
+    # DCN psum's output is host-replicated, and declaring it
+    # P((host, device)) just keeps every device's copy addressable — fine
+    # for a timing probe, check_vma off)
+    x_full = _payload(n * c)
+    x_chunk = _payload(n * (c // n_d))
+    rs = _program(
+        lambda v: jax.lax.psum_scatter(v, d_ax, scatter_dimension=0, tiled=True)
+    )
+    dcn = _program(lambda v: jax.lax.psum(v, h_ax))
+    ag = _program(lambda v: jax.lax.all_gather(v, d_ax, tiled=True))
+
+    def timed(name: str, fn, x) -> float:
+        jax.block_until_ready(fn(x))  # compile + warm
+        best = float("inf")
+        with tracer.span(name, cat="comm"):
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(x))
+                best = min(best, time.perf_counter() - t0)
+        return best
+
+    walls = {
+        "comm_reduce_scatter": timed("comm_reduce_scatter", rs, x_full),
+        "comm_dcn": timed("comm_dcn", dcn, x_chunk),
+        "comm_gather": timed("comm_gather", ag, x_chunk),
+    }
+    # The gating reference: the flat combine IS one psum over every axis at
+    # full width, so the gate compares the measured three-phase hier wall
+    # against the measured flat wall on the same payload — a derived
+    # bandwidth ratio would misread overhead-dominated links (a tiny DCN
+    # chunk pays full dispatch latency and reads as "slow" even when the
+    # link is not).
+    flat_fn = _program(lambda v: jax.lax.psum(v, both))
+    flat_wall = timed("comm_flat_ref", flat_fn, x_full)
+    hier_wall = sum(walls.values())
+    ici_wall = 0.5 * (walls["comm_reduce_scatter"] + walls["comm_gather"])
+    chunk_bytes = (c // n_d) * 4
+    return {
+        "ici_bytes_per_s": (c * 4) / max(ici_wall, 1e-9),
+        "dcn_bytes_per_s": chunk_bytes / max(walls["comm_dcn"], 1e-9),
+        "phase_s": {k: round(v, 6) for k, v in walls.items()},
+        "flat_wall_s": round(flat_wall, 6),
+        "hier_wall_s": round(hier_wall, 6),
+        # hier must beat flat with margin at FULL precision structure; the
+        # compressed wire only widens its win (fewer DCN bytes)
+        "hier_wins": bool(hier_wall < 0.95 * flat_wall),
+        "hosts": int(n_h),
+        "devices_per_host": int(n_d),
+    }
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

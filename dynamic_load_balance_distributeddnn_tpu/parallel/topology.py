@@ -13,7 +13,39 @@ processes involved.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def factor_hosts(devices: Sequence, requested: int = 0) -> Optional[int]:
+    """Two-level ICI/DCN factorization of a mesh-ordered device list: the
+    host-group count H such that ``devices`` splits into H equal contiguous
+    blocks, each living on one host — the precondition for
+    ``parallel/mesh.py hier_mesh`` (row k = host k's chips, row-major device
+    order identical to the flat mesh).
+
+    ``requested > 0`` forces a SYNTHETIC factorization (single-process CPU
+    tiers, tests, the grad_comm bench — there is no real DCN but the
+    collective structure is exercised end to end). Returns None when no
+    usable two-level structure exists (fewer than two groups, uneven or
+    non-contiguous host blocks) — the caller falls back to the flat
+    combine."""
+    n = len(devices)
+    if requested:
+        if requested < 2 or requested > n or n % requested:
+            return None
+        return int(requested)
+    by_proc: Dict[int, List[int]] = {}
+    for i, d in enumerate(devices):
+        by_proc.setdefault(int(getattr(d, "process_index", 0)), []).append(i)
+    if len(by_proc) < 2:
+        return None  # one host: no DCN link to shorten
+    sizes = {len(v) for v in by_proc.values()}
+    if len(sizes) != 1:
+        return None  # ragged hosts cannot form a rectangular axis
+    for idxs in by_proc.values():
+        if idxs != list(range(idxs[0], idxs[0] + len(idxs))):
+            return None  # host blocks must be contiguous in mesh order
+    return len(by_proc)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -128,6 +128,17 @@ class Trainer:
         self.timing_model = timing_model
         self.logger = logger or init_logger(cfg, to_file=log_to_file)
 
+        # graftscope tracer, configured FIRST (see the fuller note at the
+        # MetricsRegistry construction below): instrumentation that runs
+        # during init itself — the hier combine's link-bandwidth probe and
+        # its comm_* phase spans — must land in THIS run's trace, not the
+        # previous configuration's buffer (or the void)
+        self._trace = get_tracer().configure(
+            cfg.trace,
+            ring_size=cfg.trace_ring,
+            jax_annotations=cfg.trace_annotations,
+        )
+
         # Multi-host: each process owns a contiguous slice of the global
         # workers, mapped onto its LOCAL devices; the combine mesh spans every
         # process's used devices (XLA collectives ride ICI within a host, DCN
@@ -179,8 +190,85 @@ class Trainer:
             for p in sorted(by_proc):
                 proc_devs = sorted(by_proc[p], key=lambda d: d.id)
                 mesh_devices.extend(proc_devs[i] for i in used)
-        self.mesh = data_mesh(mesh_devices)
+        # Hierarchical ICI/DCN combine (ISSUE 12): resolve --grad_comm hier
+        # into a two-level (host, device) mesh when the device list factors
+        # into host groups (real process topology, or the synthetic
+        # --hier_hosts split on CPU tiers). self.grad_comm is the RUNTIME
+        # choice — "flat" whenever no factorization exists or the bandwidth
+        # probe says the fabric gains nothing — and everything downstream
+        # (StepLibrary axes, combine dispatch, AOT keys, bytes-on-wire
+        # accounting) keys off it, never off cfg.grad_comm.
+        self.grad_comm = "flat"
+        self._hier_hosts = 0
+        self._link_bw: Optional[Dict] = None
+        if cfg.grad_comm == "hier":
+            from dynamic_load_balance_distributeddnn_tpu.parallel.topology import (
+                factor_hosts,
+            )
+
+            hosts = factor_hosts(mesh_devices, requested=cfg.hier_hosts)
+            if hosts is None:
+                self.logger.warning(
+                    "grad_comm=hier: no (host, device) factorization of "
+                    f"{len(mesh_devices)} devices "
+                    f"(hier_hosts={cfg.hier_hosts}, processes={self.n_proc})"
+                    " — falling back to the flat combine"
+                )
+            else:
+                self.grad_comm = "hier"
+                self._hier_hosts = hosts
+        if self.grad_comm == "hier":
+            from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+                hier_mesh,
+                probe_link_bandwidth,
+            )
+
+            self.mesh = hier_mesh(mesh_devices, self._hier_hosts)
+            # The three-phase probe always runs on a SINGLE-PROCESS hier
+            # mesh — its comm_reduce_scatter/comm_dcn/comm_gather spans and
+            # per-link bytes/s are the run's comm observability — but it
+            # only GATES (falls back to flat) when the operator opted in:
+            # forced hier on a deliberately synthetic split (tests, the
+            # bench) must stay hier. Multi-host runs skip it entirely: the
+            # probe device_puts host-local arrays onto the global mesh
+            # (non-addressable from any one process), and a per-process
+            # wall-clock verdict could DIVERGE across hosts — half the
+            # fleet on a 2-D mesh, half flat, deadlocked at the first
+            # collective. Real pods trust --grad_comm until the probe
+            # learns a replicated decision channel (ROADMAP).
+            if self.n_proc == 1:
+                self._link_bw = probe_link_bandwidth(self.mesh)
+                heartbeat()
+            elif cfg.dcn_bandwidth_probe:
+                self.logger.warning(
+                    "dcn_bandwidth_probe is single-process-only today — "
+                    "keeping grad_comm=hier as configured"
+                )
+            if cfg.dcn_bandwidth_probe and self._link_bw is not None:
+                if not self._link_bw["hier_wins"]:
+                    self.logger.warning(
+                        "grad_comm=hier: bandwidth probe measured the "
+                        "three-phase hier structure at "
+                        f"{self._link_bw['hier_wall_s']:.4f}s vs "
+                        f"{self._link_bw['flat_wall_s']:.4f}s for one flat "
+                        "psum (no slow DCN link to shorten) — falling back "
+                        "to the flat combine"
+                    )
+                    self.grad_comm = "flat"
+                    self._hier_hosts = 0
+                    self.mesh = data_mesh(mesh_devices)
+        else:
+            self.mesh = data_mesh(mesh_devices)
         self.n_dev = len(mesh_devices)
+        # AOT-key / plan-layout signature of the combine structure: a new
+        # axis factorization or wire format is a new compiled-program
+        # universe, so it participates in every registry key the combine
+        # and fused executables are filed under.
+        self._comm_sig = (
+            ("hier", cfg.grad_comm_wire, self._hier_hosts)
+            if self.grad_comm == "hier"
+            else ("flat",)
+        )
 
         self._setup_data(bundle)
         self._setup_model()
@@ -300,6 +388,16 @@ class Trainer:
         # cross-round comparisons can detect the definition boundary instead
         # of silently mixing the two.
         self.recorder.meta["wall_excludes_probes"] = True
+        # combine-structure provenance: which collective this run's walls
+        # were measured under (and what the bandwidth probe saw, if it ran)
+        self.recorder.meta["grad_comm"] = self.grad_comm
+        if self.grad_comm == "hier":
+            self.recorder.meta["grad_comm_wire"] = cfg.grad_comm_wire
+            self.recorder.meta["grad_comm_hosts"] = self._hier_hosts
+        if self._link_bw is not None:
+            self.recorder.meta["link_bandwidth"] = {
+                k: v for k, v in self._link_bw.items()
+            }
         # induced-straggler provenance: lets offline tooling compute the
         # ideal equilibrium partition (share_i ∝ 1/f_i) and report the
         # balancer-quality convergence metric (BASELINE.md §protocol)
@@ -381,16 +479,12 @@ class Trainer:
         # surfaces. trace="off" keeps every span call a single attribute
         # check (no buffer, no jax — sentinel-silent under the compile
         # guards); the trace saves at end of run (run()).
-        # The engine OWNS the process-wide tracer config: configure
-        # unconditionally, so a trace="off" run can never inherit an earlier
-        # traced run's enabled state (and its wall overhead + surprise
-        # trace file) from the same process — bench arms, test suites and
-        # notebook drivers all build engines back to back.
-        self._trace = get_tracer().configure(
-            cfg.trace,
-            ring_size=cfg.trace_ring,
-            jax_annotations=cfg.trace_annotations,
-        )
+        # The engine OWNS the process-wide tracer config: configured
+        # unconditionally (at the TOP of __init__, before the mesh/probe
+        # block), so a trace="off" run can never inherit an earlier traced
+        # run's enabled state (and its wall overhead + surprise trace file)
+        # from the same process — bench arms, test suites and notebook
+        # drivers all build engines back to back.
         self.obs = MetricsRegistry(recorder=self.recorder, tracer=self._trace)
         self.obs.attach(
             host_meter=self._host_meter,
@@ -507,6 +601,14 @@ class Trainer:
             )
 
             self.state = shard_optimizer_state(self.state, self.mesh, cfg.momentum)
+        if self.grad_comm == "hier":
+            from dynamic_load_balance_distributeddnn_tpu.train.state import (
+                attach_comm_residual,
+            )
+
+            # zero error-feedback residual, [n_dev, chunk] one row per
+            # device over the two-level mesh; checkpoints restore into it
+            self.state = attach_comm_residual(self.state, self.mesh)
         self._build_steps()
 
     def _build_steps(self) -> None:
@@ -531,6 +633,8 @@ class Trainer:
             grad_accum=cfg.grad_accum,
             compress_grads=cfg.compress_grads,
             remat=cfg.remat,
+            grad_comm=self.grad_comm,
+            grad_comm_wire=cfg.grad_comm_wire,
         )
         if getattr(self, "_aot", None) is not None:
             self.steps.aot_service = self._aot
@@ -596,6 +700,24 @@ class Trainer:
     def _aot_step_key(self, kind: str, b: int, d: int, win: Optional[int]) -> tuple:
         return (kind, int(b), int(win or 0), int(d), self._aot_gen)
 
+    @property
+    def _batch_axes(self):
+        """PartitionSpec entry splitting a batch dim over the whole mesh —
+        the lone axis name (flat) or the (host, device) tuple (hier)."""
+        from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+            mesh_batch_axes,
+        )
+
+        return mesh_batch_axes(self.mesh)
+
+    def _combine_names(self) -> "tuple[str, str]":
+        """(update, probe) combine executable names for the active combine
+        structure — the hier twins ride the two-level mesh, the flat pair
+        the single psum."""
+        if self.grad_comm == "hier":
+            return ("combine_update_hier", "combine_probe_hier")
+        return ("combine_update", "combine_probe")
+
     def _aot_view_spec(self, d: int):
         """Abstract spec of device d's params view: shapes/dtypes/shardings
         never change across steps, so one spec serves the whole run (and
@@ -607,6 +729,43 @@ class Trainer:
                 views[d],
             )
         return self._aot_view_specs[d]
+
+    def _comm_bytes_per_step(self) -> "tuple[float, float]":
+        """(ICI bytes, DCN bytes) of ONE gradient combine — the logical
+        per-device payload each link class carries, the series the
+        grad_comm bench reports per arm.
+
+        flat: the full f32 tree rides every link it spans — ICI always, DCN
+        only when the mesh actually crosses hosts (real processes; a
+        single-process synthetic split has no DCN and records 0).
+        hier: reduce-scatter + all-gather keep 2x the tree on ICI at full
+        precision, and only the 1/D chunk crosses DCN in the wire's sum
+        dtype (parallel/wire.py wire_payload_bytes)."""
+        from dynamic_load_balance_distributeddnn_tpu.parallel.wire import (
+            wire_payload_bytes,
+        )
+
+        if not hasattr(self, "_param_elems"):
+            self._param_elems = int(
+                sum(p.size for p in jax.tree_util.tree_leaves(self.state.params))
+            )
+        elems = self._param_elems
+        if self.grad_comm == "hier":
+            n_d = self.n_dev // max(self._hier_hosts, 1)
+            chunk = -(-elems // n_d)
+            dcn = chunk * wire_payload_bytes(
+                self.cfg.grad_comm_wire, self._hier_hosts
+            )
+            # one device per host: the in-host reduce-scatter/all-gather
+            # are identities — no ICI traffic to account
+            ici = 2 * elems * 4 if n_d > 1 else 0
+            return float(ici), float(dcn)
+        # flat: compress_grads rides its own int16 wire (half the f32 bytes)
+        per_elem = 2 if self.cfg.compress_grads == "int8" else 4
+        return (
+            float(elems * per_elem),
+            float(elems * per_elem if self.n_proc > 1 else 0),
+        )
 
     def _aot_resolve(self, kind: str, b: int, d: int, win: Optional[int], fallback):
         """Compiled executable for a dispatch site, or the lazy jit
@@ -732,7 +891,10 @@ class Trainer:
 
     def _aot_fused_key(self, n_win: int, width: int, slow_len: int) -> tuple:
         name = "fused_epoch_idx" if self._use_device_cache else "fused_epoch"
-        return (name, int(n_win), int(width), int(slow_len), self._aot_gen)
+        return (
+            (name, int(n_win), int(width), int(slow_len), self._aot_gen)
+            + self._comm_sig
+        )
 
     def _aot_submit_fused(self, n_win: int, width: int, slow_len: int) -> list:
         """Queue one fused whole-epoch-scan window executable
@@ -759,15 +921,17 @@ class Trainer:
         def sds(shape, dt, sh):
             return jax.ShapeDtypeStruct(tuple(int(s) for s in shape), dt, sharding=sh)
 
+        bx = self._batch_axes
+
         def win_spec(shape, dt):
             full = (n_win, width) + tuple(shape)
-            return sds(full, dt, batch_sharding(mesh, len(full), axis_dim=1))
+            return sds(full, dt, batch_sharding(mesh, len(full), axis=bx, axis_dim=1))
 
         (xs_, xd), (ys_, yd), (ws_sh, wd) = [
             (s[1:], dt) for s, dt in self._dummy_arg_shapes(1)
         ]
         w_t = win_spec(ws_sh, wd)
-        slow_t = sds((slow_len,), jnp.int32, batch_sharding(mesh, 1))
+        slow_t = sds((slow_len,), jnp.int32, batch_sharding(mesh, 1, axis=bx))
         seed_t = sds((), jnp.int32, replicated_sharding(mesh))
         if use_cache:
             cache_x, cache_y = self._device_cache_replicated()
@@ -818,9 +982,8 @@ class Trainer:
         if svc is None or self.n_proc > 1:
             return []
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS
 
-        sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        sh = NamedSharding(self.mesh, P(self._batch_axes))
         stacked_t = jax.tree_util.tree_map(
             lambda p: jax.ShapeDtypeStruct(
                 (self.n_dev,) + tuple(p.shape), p.dtype, sharding=sh
@@ -828,8 +991,8 @@ class Trainer:
             self.state.params,
         )
         keys = []
-        for name in ("combine_update", "combine_probe"):
-            k = (name, self._aot_gen)
+        for name in self._combine_names():
+            k = (name, self._aot_gen) + self._comm_sig
             if not svc.has(k):
                 svc.submit(k, getattr(self.steps, name), (self.state, stacked_t))
             keys.append(k)
@@ -838,7 +1001,7 @@ class Trainer:
     def _aot_resolve_combine(self, name: str, fallback):
         if self._aot is None:
             return fallback
-        return self._aot.get((name, self._aot_gen)) or fallback
+        return self._aot.get((name, self._aot_gen) + self._comm_sig) or fallback
 
     def _submit_warm_aot(self) -> None:
         """AOT warm-start: submit the whole compile universe and return
@@ -2267,6 +2430,12 @@ class Trainer:
             extras["plan_switches"] = float(ctl.switches - self._switches_last)
             self._switches_last = ctl.switches
             self.recorder.meta["rebalance_controller"] = ctl.snapshot()
+        # bytes-on-wire series (ISSUE 12): what this epoch's gradient
+        # combines moved per link class under the active structure — the
+        # quantity the hierarchical collective exists to shrink on DCN
+        ici_b, dcn_b = self._comm_bytes_per_step()
+        extras["comm_bytes_ici"] = ici_b * plan.num_steps
+        extras["comm_bytes_dcn"] = dcn_b * plan.num_steps
         # elastic-path host-overhead walls (superstep A/B instrumentation;
         # absent on the fused paths, whose dispatch is one scan per window)
         for k in ("host_dispatch_s", "host_put_s", "host_overhead_per_step_s"):
@@ -2315,7 +2484,8 @@ class Trainer:
         # the streaming window lengths (superstep/windowed executables
         # specialize on them — ISSUE 2's (shape, window) cache key)
         plan_layout = (
-            (int(plan.num_steps),)
+            self._comm_sig
+            + (int(plan.num_steps),)
             + tuple((int(w.padded_batch), int(w.steps)) for w in plan.workers)
             + tuple(s1 - s0 for s0, s1 in self._elastic_ranges(plan.num_steps))
             # mid-epoch switches (rebalance=window) dispatch ADDITIONAL
@@ -2701,14 +2871,15 @@ class Trainer:
         from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
 
         mesh = self.mesh
+        bx = self._batch_axes
         if self.n_proc == 1:
             return tuple(
-                jax.device_put(a, batch_sharding(mesh, a.ndim, axis_dim=1))
+                jax.device_put(a, batch_sharding(mesh, a.ndim, axis=bx, axis_dim=1))
                 for a in arrays
             )
         return tuple(
             jax.make_array_from_process_local_data(
-                batch_sharding(mesh, a.ndim, axis_dim=1), a
+                batch_sharding(mesh, a.ndim, axis=bx, axis_dim=1), a
             )
             for a in arrays
         )
@@ -2735,21 +2906,22 @@ class Trainer:
         from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
 
         mesh = self.mesh
+        bx = self._batch_axes
         if packed:
             slow = jax.device_put(
                 np.array(
                     [faults.slow_iters_per_step.sum()], dtype=np.int32
                 ),
-                batch_sharding(mesh, 1),
+                batch_sharding(mesh, 1, axis=bx),
             )
         elif self.n_proc == 1:
             slow = jax.device_put(
                 faults.slow_iters_per_step.astype(np.int32),
-                batch_sharding(mesh, 1),
+                batch_sharding(mesh, 1, axis=bx),
             )
         else:
             slow = jax.make_array_from_process_local_data(
-                batch_sharding(mesh, 1),
+                batch_sharding(mesh, 1, axis=bx),
                 faults.slow_iters_per_step.astype(np.int32)[
                     self.rank_lo : self.rank_lo + self.ws_local
                 ],
@@ -2841,6 +3013,7 @@ class Trainer:
                 if self._aot is not None:
                     pre = self._aot.get(
                         ("fused_step_probe", self._aot_gen)
+                        + self._comm_sig
                         + tuple(int(s) for s in xs[0].shape)
                     )
                 f = compiled_flops(
@@ -2916,7 +3089,9 @@ class Trainer:
         if self._aot is None or self.n_proc > 1:
             return fn
         try:
-            return self._aot.compile_now((name, self._aot_gen) + sig, fn, args)
+            return self._aot.compile_now(
+                (name, self._aot_gen) + self._comm_sig + sig, fn, args
+            )
         except Exception as e:
             self.logger.warning(
                 f"AOT compile_now({name}) failed: {e!r} — using lazy jit"
@@ -3098,7 +3273,8 @@ class Trainer:
                     self._aot_resolve("worker_first" + suffix, b, d, wl, step_first),
                     self._aot_resolve("worker_acc" + suffix, b, d, wl, step_acc),
                 )
-        combine = self._aot_resolve_combine("combine_update", steps.combine_update)
+        up_name = self._combine_names()[0]
+        combine = self._aot_resolve_combine(up_name, getattr(steps, up_name))
         for s in range(win):
             s_i = np.int32(s)
             with self._host_meter.dispatch():
@@ -3944,8 +4120,9 @@ class Trainer:
         # warm (compile) untimed, then time the pure collective+update; the
         # combine twin resolves from the AOT registry (warm-submitted) so the
         # warm call is a dispatch, not a lazy compile
+        probe_name = self._combine_names()[1]
         combine_probe = self._aot_resolve_combine(
-            "combine_probe", self.steps.combine_probe
+            probe_name, getattr(self.steps, probe_name)
         )
         jax.block_until_ready(combine_probe(self.state, stacked).params)
         t0 = time.perf_counter()
@@ -4020,13 +4197,18 @@ class Trainer:
         chunk = per_dev * self.n_dev
         from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import batch_sharding
 
+        bx = self._batch_axes
+
         def put(arr):
             if self.n_proc == 1:
-                return jax.device_put(arr, batch_sharding(self.mesh, arr.ndim))
+                return jax.device_put(
+                    arr, batch_sharding(self.mesh, arr.ndim, axis=bx)
+                )
             rows = chunk // self.n_proc
             lo_p = self.proc_id * rows
             return jax.make_array_from_process_local_data(
-                batch_sharding(self.mesh, arr.ndim), arr[lo_p : lo_p + rows]
+                batch_sharding(self.mesh, arr.ndim, axis=bx),
+                arr[lo_p : lo_p + rows],
             )
 
         # With the device cache on and a caller-declared stable input set

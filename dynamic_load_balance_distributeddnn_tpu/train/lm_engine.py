@@ -95,6 +95,15 @@ class LMTrainer(Trainer):
             seed=cfg.seed,
             sharding=replicated_sharding(self.mesh),
         )
+        if self.grad_comm == "hier":
+            from dynamic_load_balance_distributeddnn_tpu.train.state import (
+                attach_comm_residual,
+            )
+
+            # hierarchical combine (ISSUE 12): the LM's elastic dispatch
+            # rides the hier combine twins like the vision path — the
+            # error-feedback residual travels in the TrainState
+            self.state = attach_comm_residual(self.state, self.mesh)
         grad_clip = cfg.grad_clip if cfg.grad_clip > 0 else 0.25  # dbs.py:274
         self.steps = StepLibrary(
             self.spec,
@@ -105,6 +114,8 @@ class LMTrainer(Trainer):
             use_pallas=cfg.use_pallas,
             grad_accum=cfg.grad_accum,
             remat=cfg.remat,
+            grad_comm=self.grad_comm,
+            grad_comm_wire=cfg.grad_comm_wire,
         )
 
     def _dummy_batch(self, b: int):

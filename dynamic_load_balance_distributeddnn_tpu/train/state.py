@@ -28,6 +28,16 @@ class TrainState:
     params: Any
     opt_state: Any
     step: jnp.ndarray  # global step counter
+    # Error-feedback residual of the hierarchical compressed gradient
+    # collective (ISSUE 12): each device's accumulated quantization error on
+    # its reduce-scattered chunk, [n_devices, chunk] sharded one row per
+    # device over the two-level mesh. None (an empty pytree subtree — no
+    # leaf, no signature change) on every non-hierarchical run; attached by
+    # attach_comm_residual when --grad_comm hier resolves. Carried in the
+    # state so it donates/checkpoints/restores with the weights — dropping
+    # it between steps would silently discard the compression error the
+    # biased wires (int4) rely on re-injecting.
+    comm_residual: Any = None
 
     def learning_rate(self) -> float:
         return float(self.opt_state.hyperparams["learning_rate"])
@@ -105,6 +115,38 @@ def shard_optimizer_state(state: TrainState, mesh, momentum: float = 0.9) -> Tra
         count=jax.device_put(jnp.zeros((), jnp.int32), rep),
     )
     return state.replace(opt_state=opt_state)
+
+
+def residual_chunk_size(params, devices_per_host: int) -> int:
+    """Per-device error-feedback chunk width: the raveled param count padded
+    up to a multiple of the in-host device count (the reduce-scatter's
+    divisibility requirement) divided by it. ravel_pytree's flat size is
+    exactly the sum of leaf sizes, so count leaves instead of
+    materializing a full flattened copy at init. Must match the
+    hierarchical combine's padding arithmetic (parallel/wire.py
+    hier_tree_allreduce)."""
+    total = int(sum(p.size for p in jax.tree_util.tree_leaves(params)))
+    padded = -(-total // devices_per_host) * devices_per_host
+    return padded // devices_per_host
+
+
+def attach_comm_residual(state: TrainState, mesh) -> TrainState:
+    """Attach a zero error-feedback residual sized for ``mesh``'s two-level
+    factorization: [n_devices, chunk] f32, one row per device (leading axis
+    split over BOTH mesh axes, row-major — the flat device order). Fresh
+    runs start at zero error by definition; checkpoint restore replaces the
+    zeros with the saved residual through the ordinary state template."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    names = tuple(mesh.axis_names)
+    if len(names) != 2:
+        raise ValueError("attach_comm_residual needs a two-level (host, device) mesh")
+    n = int(np.prod(tuple(mesh.shape.values())))
+    chunk = residual_chunk_size(state.params, int(mesh.shape[names[1]]))
+    residual = jax.device_put(
+        jnp.zeros((n, chunk), jnp.float32), NamedSharding(mesh, P(names))
+    )
+    return state.replace(comm_residual=residual)
 
 
 def create_state(

@@ -41,6 +41,7 @@ from dynamic_load_balance_distributeddnn_tpu.ops.losses import (
     per_example_cross_entropy,
     per_example_nll,
 )
+from dynamic_load_balance_distributeddnn_tpu.parallel import wire as wirefmt
 from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS, shard_map
 from dynamic_load_balance_distributeddnn_tpu.train.state import TrainState
 
@@ -80,10 +81,33 @@ class StepLibrary:
         grad_accum: int = 1,
         compress_grads: str = "",
         remat: bool = False,
+        grad_comm: str = "flat",
+        grad_comm_wire: str = "int8",
     ):
         self.spec = spec
         self.mesh = mesh
         self.tx = tx
+        # Hierarchical ICI/DCN gradient collective (ISSUE 12): on a
+        # two-level ("host", "device") mesh, the combine reduce-scatters
+        # in-host over ICI at full precision, crosses hosts on the
+        # compressed grad_comm_wire (parallel/wire.py) with error-feedback
+        # residuals carried in the TrainState, and all-gathers back. "flat"
+        # keeps the one-psum combine (the only choice on a 1-D mesh).
+        self.grad_comm = grad_comm
+        self.grad_comm_wire = grad_comm_wire
+        self.axes = tuple(mesh.axis_names)
+        self.hier = grad_comm == "hier" and len(self.axes) == 2
+        if grad_comm == "hier" and len(self.axes) != 2:
+            raise ValueError(
+                "grad_comm='hier' needs a two-level (host, device) mesh "
+                "(parallel/mesh.py hier_mesh); the engine resolves the "
+                "factorization and falls back to flat when none exists"
+            )
+        if self.hier and shard_update:
+            raise ValueError(
+                "grad_comm='hier' with shard_update is not composed yet "
+                "(ROADMAP: let the ZeRO-1 reduce_scatter ride the wire)"
+            )
         self.mean = mean
         self.std = std
         self.augment = augment
@@ -421,6 +445,56 @@ class StepLibrary:
             n += self.aot_service.count_keys(("group_superstep",))
         return n
 
+    # ------------------------------------------- hierarchical combine twins
+    # (elastic dispatch, ISSUE 12): drop-in replacements for combine_update
+    # / combine_probe when the two-level mesh is active. Each device sums
+    # its own [1, ...] slice of the stacked partials, then the combine runs
+    # the same reduce-scatter / compressed-DCN-hop / all-gather spine as the
+    # fused body — three collectives total for the whole tree — with the
+    # error-feedback residual carried through the TrainState.
+
+    def _hier_combine_body(self, state: TrainState, stacked):
+        local = jax.tree_util.tree_map(lambda g: jnp.sum(g, axis=0), stacked)
+        rng = jax.random.fold_in(
+            jax.random.fold_in(
+                jax.random.PRNGKey(0x5D1E), self._data_axis_index()
+            ),
+            state.step,
+        )
+        grads, new_residual = self._hier_combine(
+            local, rng, state.comm_residual
+        )
+        updates, opt_state = self.tx.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(
+            params=params, opt_state=opt_state, step=state.step + 1,
+            comm_residual=new_residual,
+        )
+
+    def _hier_combine_twin(self, donate: bool):
+        sharded = shard_map(
+            self._hier_combine_body,
+            mesh=self.mesh,
+            in_specs=(self._state_spec(), P(self._batch_entry)),
+            out_specs=self._state_spec(),
+            check_vma=False,
+        )
+        if donate:
+            return jax.jit(sharded, donate_argnums=(0, 1))
+        return jax.jit(sharded)
+
+    @functools.cached_property
+    def combine_update_hier(self):
+        return self._hier_combine_twin(donate=True)
+
+    @functools.cached_property
+    def combine_probe_hier(self):
+        """Non-donating twin for timing probes (inputs stay valid, result —
+        including the would-be residual update — is discarded)."""
+        return self._hier_combine_twin(donate=False)
+
     # ------------------------------------------------------- AOT lowerables
     # The executable families the async compile service can pre-compile,
     # keyed by the names the engine uses in its service keys. Since ISSUE 5
@@ -434,6 +508,17 @@ class StepLibrary:
     # match from the live arrays).
 
     def aot_lowerables(self) -> Dict[str, Callable]:
+        out = {}
+        if self.hier:
+            # hier combine twins exist only on the two-level mesh (building
+            # them on a flat mesh would trace collectives over axes the
+            # mesh does not define)
+            out["combine_update_hier"] = self.combine_update_hier
+            out["combine_probe_hier"] = self.combine_probe_hier
+        out.update(self._aot_lowerables_base())
+        return out
+
+    def _aot_lowerables_base(self) -> Dict[str, Callable]:
         return {
             "worker_first": self.worker_step_first,
             "worker_acc": self.worker_step_acc,
@@ -455,17 +540,95 @@ class StepLibrary:
     # (evaluation is always the sharded fused_eval_step — there is no
     # single-device eval path)
 
+    # -------------------------------------------------- mesh-axis plumbing
+    # The mesh is 1-D ("data") on flat runs and 2-D ("host", "device") when
+    # the hierarchical combine resolved. Every collective/spec in the fused
+    # bodies routes through these helpers so one code path serves both
+    # factorizations — on a flat mesh each helper degenerates to exactly
+    # the pre-hier spelling (same axis string, same lowering, bitwise-same
+    # programs).
+
+    @property
+    def _axis_arg(self):
+        """Collective axis argument — the lone axis name, or the axis tuple
+        (jax.lax collectives reduce over every named axis). ONE source of
+        truth with the engine's placement specs: parallel/mesh.py
+        ``mesh_batch_axes`` — collectives and batch sharding diverging on
+        which axes "the whole mesh" means would reduce gradients over a
+        different axis set than the data is sharded on."""
+        from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+            mesh_batch_axes,
+        )
+
+        return mesh_batch_axes(self.mesh)
+
+    @property
+    def _batch_entry(self):
+        """PartitionSpec entry splitting a batch dim over the whole mesh —
+        the same value as :attr:`_axis_arg` (P treats a tuple entry as one
+        dim split over all named axes); kept as its own name so spec sites
+        read as sharding, collective sites as reduction."""
+        return self._axis_arg
+
+    def _data_axis_index(self):
+        """Flat device position inside a shard_map body: identical numbering
+        under both factorizations (row-major ``h*D + d``), so per-device rng
+        folds are invariant to the mesh shape."""
+        if len(self.axes) == 1:
+            return jax.lax.axis_index(self.axes[0])
+        h_ax, d_ax = self.axes
+        n_d = int(self.mesh.shape[d_ax])
+        return jax.lax.axis_index(h_ax) * n_d + jax.lax.axis_index(d_ax)
+
+    # -------------------------------------- hierarchical ICI/DCN combine
+    # (ISSUE 12, after DynamiQ's compressed multi-hop all-reduce): in-host
+    # reduce-scatter at full precision over the fast ICI axis, ONE
+    # compressed hop across the slow DCN axis on 1/D of the tree, in-host
+    # all-gather back. Error-feedback residuals (TrainState.comm_residual)
+    # make the biased wires convergent (parallel/wire.py).
+
+    def _hier_combine(self, grads, rng, residual):
+        """Two-level gradient reduction inside a shard_map body.
+
+        ``grads``: this device's local gradient tree. ``residual``: this
+        device's [1, chunk] error-feedback slice of
+        ``TrainState.comm_residual``. Returns ``(reduced grads tree,
+        new [1, chunk] residual)``. The tree is raveled ONCE so the whole
+        combine is three collectives regardless of leaf count (the flat
+        combine pays one psum per leaf); the spine itself lives in
+        parallel/wire.py so the grad_comm bench times the identical code."""
+        h_ax, d_ax = self.axes
+        out, new_residual = wirefmt.hier_tree_allreduce(
+            grads,
+            rng,
+            h_ax,
+            d_ax,
+            int(self.mesh.shape[h_ax]),
+            int(self.mesh.shape[d_ax]),
+            self.grad_comm_wire,
+            residual=(residual[0] if residual is not None else None),
+        )
+        return out, new_residual[None]
+
     def _state_spec(self):
         """shard_map spec for the TrainState: fully replicated, except the
         flat momentum trace when weight-update sharding is on (prefix-spec
-        pytree: ``params=P()`` covers the whole params subtree)."""
-        if not self.shard_update:
-            return P()
+        pytree: ``params=P()`` covers the whole params subtree) and the
+        per-device error-feedback residual on hierarchical runs."""
         from dynamic_load_balance_distributeddnn_tpu.train.state import (
             ShardedSGDState,
             TrainState as TS,
         )
 
+        if self.hier:
+            return TS(
+                params=P(),
+                opt_state=P(),
+                step=P(),
+                comm_residual=P(self._batch_entry),
+            )
+        if not self.shard_update:
+            return P()
         return TS(
             params=P(),
             opt_state=ShardedSGDState(
@@ -475,6 +638,7 @@ class StepLibrary:
                 count=P(),
             ),
             step=P(),
+            comm_residual=P(),
         )
 
     def _fused_shard_body(self, state, x, y, w, slow_scalar, seed, with_comm=True):
@@ -489,7 +653,7 @@ class StepLibrary:
         (dbs.py:297-299)."""
         spec = self.spec
         tx = self.tx
-        idx = jax.lax.axis_index(DATA_AXIS)
+        idx = self._data_axis_index()
         rng = jax.random.fold_in(
             jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(0), seed), idx),
             state.step,
@@ -552,38 +716,44 @@ class StepLibrary:
         if self.shard_update:
             state = self._zero1_update(state, grads, with_comm)
             if with_comm:
-                metrics = jax.lax.psum(metrics, DATA_AXIS)
+                metrics = jax.lax.psum(metrics, self._axis_arg)
             return state, metrics
+        new_residual = state.comm_residual
         if with_comm:
-            if self.compress_grads == "int8":
+            if self.hier:
+                grads, new_residual = self._hier_combine(
+                    grads, jax.random.fold_in(rng, 0x7FFF), state.comm_residual
+                )
+            elif self.compress_grads == "int8":
                 grads = self._compressed_psum(grads, rng)
             else:
-                grads = jax.lax.psum(grads, DATA_AXIS)
-            metrics = jax.lax.psum(metrics, DATA_AXIS)
+                grads = jax.lax.psum(grads, self._axis_arg)
+            metrics = jax.lax.psum(metrics, self._axis_arg)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
+        state = state.replace(
+            params=params, opt_state=opt_state, step=state.step + 1,
+            comm_residual=new_residual,
+        )
         return state, metrics
 
     def _compressed_psum(self, grads, rng):
-        """Quantized gradient collective (compressed-allreduce family): per
-        leaf, all devices agree on a shared scale via pmax, quantize to
-        127 levels with stochastic rounding (E[dequant] == grad, so no
-        error-feedback buffer is required), and psum in int16 — half the
-        wire bytes of an f32 collective. The scale pmax is a scalar per leaf,
-        negligible next to the tensor traffic."""
+        """Quantized FLAT gradient collective (compressed-allreduce family):
+        per leaf, one stochastic-rounded int8 all-reduce hop over the whole
+        mesh (parallel/wire.py — E[dequant] == grad, so no error-feedback
+        buffer is required), summed in int16 on the wire — half the bytes of
+        an f32 collective. The per-leaf scale pmax is a scalar, negligible
+        next to the tensor traffic. The hierarchical combine generalizes
+        this into the cross-host hop of _hier_combine."""
         leaves, treedef = jax.tree_util.tree_flatten(grads)
+        n = len(self.mesh.devices.flat)
         out = []
         for i, g in enumerate(leaves):
             key = jax.random.fold_in(rng, i + 0x7FFF)
-            amax = jax.lax.pmax(jnp.max(jnp.abs(g)), DATA_AXIS)
-            scale = jnp.maximum(amax / 127.0, jnp.finfo(jnp.float32).tiny)
-            u = jax.random.uniform(key, g.shape, dtype=jnp.float32)
-            q = jnp.clip(
-                jnp.floor(g.astype(jnp.float32) / scale + u), -127, 127
-            ).astype(jnp.int16)
-            s = jax.lax.psum(q, DATA_AXIS)
-            out.append((s.astype(jnp.float32) * scale).astype(g.dtype))
+            total, _sent = wirefmt.compressed_reduce(
+                g, key, self._axis_arg, n, "int8"
+            )
+            out.append(total.astype(g.dtype))
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def _zero1_update(self, state, local_grads, with_comm: bool):
@@ -636,10 +806,11 @@ class StepLibrary:
         def per_shard(state, x, y, w, slow_iters, seed):
             return self._fused_shard_body(state, x, y, w, slow_iters[0], seed)
 
+        bx = self._batch_entry
         sharded = shard_map(
             per_shard,
             mesh=self.mesh,
-            in_specs=(self._state_spec(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+            in_specs=(self._state_spec(), P(bx), P(bx), P(bx), P(bx), P()),
             out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
@@ -661,15 +832,16 @@ class StepLibrary:
             state, metrics = jax.lax.scan(body, state, (xs, ys, ws_))
             return state, jnp.sum(metrics, axis=0)
 
+        bx = self._batch_entry
         sharded = shard_map(
             per_shard,
             mesh=self.mesh,
             in_specs=(
                 self._state_spec(),
-                P(None, DATA_AXIS),
-                P(None, DATA_AXIS),
-                P(None, DATA_AXIS),
-                P(DATA_AXIS),
+                P(None, bx),
+                P(None, bx),
+                P(None, bx),
+                P(bx),
                 P(),
             ),
             out_specs=(self._state_spec(), P()),
@@ -695,6 +867,7 @@ class StepLibrary:
             state, metrics = jax.lax.scan(body, state, (idxs, ws_))
             return state, jnp.sum(metrics, axis=0)
 
+        bx = self._batch_entry
         sharded = shard_map(
             per_shard,
             mesh=self.mesh,
@@ -702,9 +875,9 @@ class StepLibrary:
                 self._state_spec(),
                 P(),
                 P(),
-                P(None, DATA_AXIS),
-                P(None, DATA_AXIS),
-                P(DATA_AXIS),
+                P(None, bx),
+                P(None, bx),
+                P(bx),
                 P(),
             ),
             out_specs=(self._state_spec(), P()),
@@ -723,10 +896,11 @@ class StepLibrary:
                 state, x, y, w, slow_iters[0], seed, with_comm=with_comm
             )
 
+        bx = self._batch_entry
         sharded = shard_map(
             per_shard,
             mesh=self.mesh,
-            in_specs=(self._state_spec(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+            in_specs=(self._state_spec(), P(bx), P(bx), P(bx), P(bx), P()),
             out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
@@ -747,8 +921,10 @@ class StepLibrary:
         below timer noise — the closest analogue of the reference's blocking
         allreduce wait (dbs.py:296-298)."""
 
+        axes = self._axis_arg
+
         def per_shard(tree):
-            return jax.lax.psum(tree, DATA_AXIS)
+            return jax.lax.psum(tree, axes)
 
         sharded = shard_map(
             per_shard,
@@ -767,6 +943,7 @@ class StepLibrary:
         spec = self.spec
         apply_fn = spec.module.apply
         prep = self._prep_images
+        axes = self._axis_arg
 
         def per_shard(params, x, y, mask):
             xf = prep(x, jax.random.PRNGKey(0), train=False)
@@ -777,12 +954,13 @@ class StepLibrary:
             stats = jnp.stack(
                 [jnp.sum(losses * m), jnp.sum((pred == y).astype(jnp.float32) * m), jnp.sum(m)]
             )
-            return jax.lax.psum(stats, DATA_AXIS)
+            return jax.lax.psum(stats, axes)
 
+        bx = self._batch_entry
         sharded = shard_map(
             per_shard,
             mesh=self.mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            in_specs=(P(), P(bx), P(bx), P(bx)),
             out_specs=P(),
             check_vma=False,
         )
@@ -803,7 +981,11 @@ def stack_partials(partials_by_device, mesh: Mesh):
     n_local = len(partials_by_device)
     n_global = len(mesh.devices.flat)
     assert n_local == len([d for d in mesh.devices.flat if d.process_index == jax.process_index()])
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+        mesh_batch_axes,
+    )
+
+    sharding = NamedSharding(mesh, P(mesh_batch_axes(mesh)))
 
     leaves_by_dev = [jax.tree_util.tree_leaves(p) for p in partials_by_device]
     treedef = jax.tree_util.tree_structure(partials_by_device[0])

@@ -1,0 +1,32 @@
+"""G014 negative: call-site literal override of a DEFAULTED axis param.
+
+``build`` constructs its mesh through a defaulted ``axis`` parameter; the
+call sites override it with ``"model"``. The override must enter the axis
+universe AND the bound mesh's value environment (PR-12 satellite — before
+it, the universe held only the default ``"data"`` and every collective over
+``"model"`` was a false G014), so both the psum over "model" and the
+shard_map whose body demands "model" are clean.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def build(devices, axis="data"):
+    return Mesh(np.array(devices), (axis,))
+
+
+def combine(tree, devices):
+    mesh = build(devices, axis="model")
+    with mesh:
+        return jax.lax.psum(tree, "model")
+
+
+def body(x):
+    return jax.lax.psum(x, "model")
+
+
+def wire(devices):
+    mesh = build(devices, axis="model")
+    return jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)

@@ -1,0 +1,19 @@
+"""G014 seed: the axis-param override channel must EXTEND the universe, not
+disarm the rule — the call site defines axis "model", and the collective
+typos it as "modle", which no mesh (default "data", override "model")
+defines.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def build(devices, axis="data"):
+    return Mesh(np.array(devices), (axis,))
+
+
+def combine(tree, devices):
+    mesh = build(devices, axis="model")
+    with mesh:
+        return jax.lax.psum(tree, "modle")  # typo: not 'data', not 'model'

@@ -1,0 +1,46 @@
+"""G015 negatives for the per-executable-key matching: a dispatch whose
+placement matches ITS key's registered spec is clean, and a dispatch with
+no extractable key literal falls back to the class-wide union (strictly
+the pre-satellite behavior — precision only ever increases).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Engine:
+    def __init__(self, devices):
+        self.mesh = Mesh(np.array(devices), ("data",))
+        self._aot = object()
+
+    def _submit_fused(self, state):
+        seed_t = jax.ShapeDtypeStruct(
+            (), jnp.int32, sharding=NamedSharding(self.mesh, P())
+        )
+        self._aot.submit(("fused", 0), state, (seed_t,))
+
+    def _submit_stacked(self, grads):
+        g_t = jax.ShapeDtypeStruct(
+            (4, 8), jnp.float32, sharding=NamedSharding(self.mesh, P("data"))
+        )
+        self._aot.submit(("stacked", 0), grads, (g_t,))
+
+    def _dispatch_fused(self, epoch):
+        fn = self._aot.get(("fused", 0))
+        seed = jax.device_put(
+            jnp.int32(epoch), NamedSharding(self.mesh, P())
+        )  # matches the "fused" key's registered lowering
+        return fn, seed
+
+    def _dispatch_any(self, key, grads):
+        fn = self._aot.get(key)  # opaque key: class-wide union applies
+        stacked = jax.device_put(
+            grads, NamedSharding(self.mesh, P("data"))
+        )  # registered by the "stacked" scope
+        return fn, stacked
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))

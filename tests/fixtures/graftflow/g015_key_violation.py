@@ -1,0 +1,42 @@
+"""G015 seed: per-EXECUTABLE-KEY registered-lowering matching (PR-12
+satellite). The class registers TWO executable families under different
+specs: the "fused" key lowers with a replicated ``P()`` seed, the "stacked"
+key with a ``P("data")`` grads stack. ``_dispatch_fused`` resolves the
+"fused" key but commits its operand under ``P("data")`` — registered for
+the OTHER executable only. Class-scoped matching (the pre-satellite
+behavior) unioned both registration sets and sanctioned the mismatch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Engine:
+    def __init__(self, devices):
+        self.mesh = Mesh(np.array(devices), ("data",))
+        self._aot = object()
+
+    def _submit_fused(self, state):
+        seed_t = jax.ShapeDtypeStruct(
+            (), jnp.int32, sharding=NamedSharding(self.mesh, P())
+        )
+        self._aot.submit(("fused", 0), state, (seed_t,))
+
+    def _submit_stacked(self, grads):
+        g_t = jax.ShapeDtypeStruct(
+            (4, 8), jnp.float32, sharding=NamedSharding(self.mesh, P("data"))
+        )
+        self._aot.submit(("stacked", 0), grads, (g_t,))
+
+    def _dispatch_fused(self, epoch):
+        fn = self._aot.get(("fused", 0))
+        seed = jax.device_put(
+            jnp.int32(epoch), NamedSharding(self.mesh, P("data"))
+        )  # "fused" was lowered under P(), not P("data")
+        return fn, seed
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))

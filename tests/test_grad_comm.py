@@ -1,0 +1,335 @@
+"""Hierarchical ICI/DCN compressed gradient collectives (ISSUE 12).
+
+Contracts:
+
+* **Bitwise parity at the fp32 wire** — the two-level reduce-scatter /
+  DCN-hop / all-gather spine computes the SAME sum as one flat psum:
+  proven bitwise at the collective level on integer-valued gradients
+  (every summation order is exact), and end-to-end on a real training run
+  (identical loss trajectory and parameters, flat mesh vs 2x4 hier mesh).
+* **Unbiasedness of the int8 DCN hop** — E[dequant] == value for the
+  stochastic-rounding wire, plus a deterministic worst-case error bound on
+  the reduced sum.
+* **Error feedback** — the int4 (biased, round-to-nearest) wire leaves a
+  nonzero residual that round-trips through orbax checkpoint save/restore.
+* **Zero-foreground-compile sentinel** — a warm-started --grad_comm hier
+  run's steady-state epochs report zero foreground XLA compiles.
+* **Gating** — no factorization -> flat fallback; the bandwidth probe
+  falls back on a fabric whose "DCN" is as fast as its ICI (this CPU
+  mesh); config guards reject un-composed combinations.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.data.datasets import synthetic_dataset
+from dynamic_load_balance_distributeddnn_tpu.parallel import wire as wirefmt
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+    hier_mesh,
+    mesh_batch_axes,
+    probe_link_bandwidth,
+    shard_map,
+)
+from dynamic_load_balance_distributeddnn_tpu.parallel.topology import factor_hosts
+from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+from dynamic_load_balance_distributeddnn_tpu.train.checkpoint import (
+    flush_checkpoints,
+    restore_checkpoint,
+)
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return synthetic_dataset("mnist", n_train=256, n_test=64)
+
+
+def _cfg(**kw):
+    base = dict(
+        debug=True,
+        world_size=8,
+        batch_size=64,
+        learning_rate=0.05,
+        epoch_size=2,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=False,
+        seed=11,
+        bucket=8,
+        packed="off",
+        device_cache="off",
+        grad_comm="hier",
+        hier_hosts=2,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+# ------------------------------------------------------------- factorization
+
+
+def test_factor_hosts_units():
+    devs = jax.devices()  # 8 virtual CPU devices, one process
+    assert factor_hosts(devs) is None  # one real host: no DCN
+    assert factor_hosts(devs, requested=2) == 2
+    assert factor_hosts(devs, requested=4) == 4
+    assert factor_hosts(devs, requested=3) is None  # 8 % 3
+    assert factor_hosts(devs, requested=1) is None  # not two-level
+    assert factor_hosts(devs, requested=16) is None
+
+
+# ------------------------------------------------- collective-level parity
+
+
+def test_hier_fp32_bitwise_parity_collective():
+    """Integer-valued gradients sum EXACTLY in f32 under any grouping, so
+    the two-level spine must be bit-for-bit the flat psum."""
+    mesh = hier_mesh(jax.devices(), 2)
+    h_ax, d_ax = mesh.axis_names
+    n = len(jax.devices())
+    vals = np.random.RandomState(0).randint(-64, 64, size=(n, 133)).astype(
+        np.float32
+    )
+    x = jax.device_put(vals, NamedSharding(mesh, P((h_ax, d_ax))))
+
+    def hier_body(v):
+        flat = v[0]
+        t = flat.size
+        padded = -(-t // mesh.shape[d_ax]) * mesh.shape[d_ax]
+        flat = jnp.pad(flat, (0, padded - t))
+        chunk = jax.lax.psum_scatter(
+            flat, d_ax, scatter_dimension=0, tiled=True
+        )
+        total, _sent = wirefmt.compressed_reduce(
+            chunk, jax.random.PRNGKey(0), h_ax, mesh.shape[h_ax], "fp32"
+        )
+        return jax.lax.all_gather(total, d_ax, tiled=True)[None, :t]
+
+    def flat_body(v):
+        return jax.lax.psum(v, (h_ax, d_ax))
+
+    spec = P((h_ax, d_ax))
+    hier = jax.jit(
+        shard_map(hier_body, mesh=mesh, in_specs=spec, out_specs=spec,
+                  check_vma=False)
+    )
+    flat = jax.jit(
+        shard_map(flat_body, mesh=mesh, in_specs=spec, out_specs=spec,
+                  check_vma=False)
+    )
+    out_h = np.asarray(hier(x))
+    out_f = np.asarray(flat(x))
+    expect = vals.sum(axis=0)
+    np.testing.assert_array_equal(out_h[0], expect)
+    np.testing.assert_array_equal(out_h, out_f[:, : out_h.shape[1]])
+
+
+def test_int8_hop_unbiased_and_int4_bounded():
+    """E[dequant] == value for the stochastic int8 wire (the DCN hop's
+    rounding function), and the deterministic int4 wire's error is bounded
+    by scale/2 per element."""
+    v = jnp.asarray(
+        np.random.RandomState(3).uniform(-1.0, 1.0, size=64).astype(np.float32)
+    )
+    scale = jnp.float32(1.0 / 127.0)
+    keys = jax.random.split(jax.random.PRNGKey(7), 4096)
+    qs = jax.vmap(
+        lambda k: wirefmt.quantize_stochastic(v, k, scale, 127)
+    )(keys)
+    est = np.asarray(qs.mean(axis=0)) * float(scale)
+    # standard error of the mean of a Bernoulli split over 4096 draws is
+    # ~scale/128; 5 sigma keeps this deterministic-in-practice
+    assert np.abs(est - np.asarray(v)).max() < 5.0 * float(scale) / np.sqrt(
+        4096
+    ) + 1e-4
+    q4 = wirefmt.quantize_nearest(v, jnp.float32(1.0 / 7.0), 7)
+    err = np.abs(np.asarray(q4) * (1.0 / 7.0) - np.asarray(v))
+    assert err.max() <= 0.5 * (1.0 / 7.0) + 1e-7
+
+
+def test_wire_payload_bytes():
+    assert wirefmt.wire_payload_bytes("fp32", 2) == 4
+    assert wirefmt.wire_payload_bytes("int8", 2) == 2  # int16 sum
+    assert wirefmt.wire_payload_bytes("int4", 2) == 1  # int8 sum, 2*7 <= 127
+    assert wirefmt.wire_payload_bytes("int4", 64) == 2  # overflow -> int16
+
+
+# ------------------------------------------------------- end-to-end parity
+
+
+def test_hier_fp32_matches_flat_end_to_end(bundle):
+    """Full fused training run, flat mesh vs 2x4 hier mesh at the fp32
+    wire: identical per-device compute (same rng folds via the row-major
+    device numbering) and a mathematically-equivalent combine. The only
+    admissible difference is f32 summation ORDER (in-host-then-cross-host
+    grouping vs whatever one flat psum emits — bitwise order-independence
+    is proven by the integer-grads collective test above), so loss and
+    params must agree to accumulation-order tolerance."""
+    runs = {}
+    for name, kw in (
+        ("flat", dict(grad_comm="flat", hier_hosts=0)),
+        ("hier", dict(grad_comm_wire="fp32")),
+    ):
+        tr = Trainer(_cfg(**kw), bundle=bundle, log_to_file=False)
+        rec = tr.run()
+        runs[name] = (tr, rec)
+    assert runs["hier"][0].grad_comm == "hier"
+    assert runs["flat"][0].grad_comm == "flat"
+    np.testing.assert_allclose(
+        np.asarray(runs["flat"][1].data["train_loss"], dtype=np.float64),
+        np.asarray(runs["hier"][1].data["train_loss"], dtype=np.float64),
+        rtol=1e-5, atol=1e-6,
+    )
+    fl = jax.tree_util.tree_leaves(runs["flat"][0].state.params)
+    hl = jax.tree_util.tree_leaves(runs["hier"][0].state.params)
+    for a, b in zip(fl, hl):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+    # fp32 wire: the residual exists but stays exactly zero
+    res = runs["hier"][0].state.comm_residual
+    assert res is not None and float(np.abs(np.asarray(res)).max()) == 0.0
+
+
+def test_hier_int8_trains_and_records_wire_bytes(bundle):
+    tr = Trainer(_cfg(grad_comm_wire="int8"), bundle=bundle, log_to_file=False)
+    rec = tr.run()
+    assert np.isfinite(rec.data["train_loss"]).all()
+    # bytes-on-wire series: DCN carries the 1/D chunk in int16, ICI 2x the
+    # f32 tree (reduce-scatter + all-gather), per combine per step
+    elems = int(
+        sum(p.size for p in jax.tree_util.tree_leaves(tr.state.params))
+    )
+    n_d = tr.n_dev // 2
+    steps = 4  # n_train 256 / batch 64
+    assert rec.last("comm_bytes_ici") == pytest.approx(2 * elems * 4 * steps)
+    assert rec.last("comm_bytes_dcn") == pytest.approx(
+        -(-elems // n_d) * 2 * steps  # int16 wire sum: 2 bytes/element
+    )
+    snap = tr.obs.snapshot()
+    assert snap["comm"]["grad_comm"] == "hier"
+    assert snap["comm"]["comm_bytes_dcn"] == rec.last("comm_bytes_dcn")
+    # stochastic rounding leaves a (small) realized residual
+    assert float(np.abs(np.asarray(tr.state.comm_residual)).max()) > 0.0
+
+
+def test_hier_elastic_combine_twins(bundle):
+    """The DBS (elastic) dispatch path rides the hier combine twins: the
+    run balances normally and the residual accumulates through the
+    per-step combine_update_hier."""
+    cfg = _cfg(dynamic_batch_size=True, grad_comm_wire="int8", epoch_size=2)
+    tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+    rec = tr.run()
+    assert tr.grad_comm == "hier"
+    assert np.isfinite(rec.data["train_loss"]).all()
+    assert float(np.abs(np.asarray(tr.state.comm_residual)).max()) > 0.0
+
+
+# -------------------------------------------------- error-feedback residual
+
+
+def test_error_feedback_residual_checkpoint_roundtrip(bundle, tmp_path):
+    """The int4 wire is biased per step; its residual is REAL state — it
+    must survive checkpoint save/restore bit-for-bit (dropping it would
+    silently discard the error the next step was owed)."""
+    ck = str(tmp_path / "ck")
+    cfg = _cfg(grad_comm_wire="int4", epoch_size=1, ckpt_dir=ck)
+    tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+    tr.run()
+    flush_checkpoints(ck)
+    saved = np.asarray(tr.state.comm_residual)
+    assert np.abs(saved).max() > 0.0  # the biased wire left real error
+    tr2 = Trainer(cfg, bundle=bundle, log_to_file=False)
+    restored = restore_checkpoint(ck, tr2.state)
+    assert restored is not None
+    _epoch, state, _ctl = restored
+    np.testing.assert_array_equal(np.asarray(state.comm_residual), saved)
+    # and the restored leaf is PLACED for the two-level mesh (one row per
+    # device), ready for the donating hot path
+    assert state.comm_residual.sharding.spec == P(("host", "device"))
+    flush_checkpoints(close=True)
+
+
+# ----------------------------------------------------------------- sentinel
+
+
+def test_zero_foreground_compiles_across_hier_run(bundle):
+    """ISSUE 12 acceptance: a warm-started --grad_comm hier run compiles
+    zero steady-state foreground programs — the hier fused executables
+    AOT-lower and dispatch from the service registry like the flat ones."""
+    cfg = _cfg(
+        grad_comm_wire="int8", epoch_size=4, warm_start=True, aot_warm=True
+    )
+    tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+    rec = tr.run()
+    assert tr.grad_comm == "hier"
+    fused_keys = [
+        k
+        for k in tr._aot.keys()
+        if k[0] in ("fused_epoch", "fused_epoch_idx")
+    ]
+    assert fused_keys and all(
+        ("hier" in k) for k in fused_keys
+    ), fused_keys  # the comm structure is part of the registry key
+    compiles = rec.data["xla_compiles"]
+    assert sum(compiles[2:]) == 0, compiles
+
+
+# ------------------------------------------------------------------- gating
+
+
+def test_single_host_falls_back_to_flat(bundle):
+    tr = Trainer(
+        _cfg(hier_hosts=0), bundle=bundle, log_to_file=False
+    )  # no factorization on one process
+    assert tr.grad_comm == "flat"
+    assert tr.state.comm_residual is None
+    assert tr._comm_sig == ("flat",)
+
+
+def test_bandwidth_probe_gates_symmetric_fabric(bundle):
+    """On this CPU mesh the 'DCN' link is the same shared memory as the
+    'ICI' link, so the three-phase structure cannot beat one flat psum —
+    the probe must fall back (and record what it measured)."""
+    tr = Trainer(
+        _cfg(dcn_bandwidth_probe=True), bundle=bundle, log_to_file=False
+    )
+    assert tr.grad_comm == "flat"
+    assert tr._link_bw is not None and not tr._link_bw["hier_wins"]
+    assert set(tr._link_bw["phase_s"]) == {
+        "comm_reduce_scatter", "comm_dcn", "comm_gather",
+    }
+    assert tr.recorder.meta["grad_comm"] == "flat"
+    assert "link_bandwidth" in tr.recorder.meta
+
+
+def test_probe_link_bandwidth_reports_phases():
+    bw = probe_link_bandwidth(
+        hier_mesh(jax.devices(), 2), floats_per_device=1 << 12, reps=1
+    )
+    assert bw["hosts"] == 2 and bw["devices_per_host"] == 4
+    assert bw["ici_bytes_per_s"] > 0 and bw["dcn_bytes_per_s"] > 0
+
+
+def test_config_guards():
+    with pytest.raises(ValueError):
+        Config(grad_comm="hier", shard_update=True, dynamic_batch_size=False)
+    with pytest.raises(ValueError):
+        Config(grad_comm="hier", compress_grads="int8", fused_dbs=True)
+    with pytest.raises(ValueError):
+        Config(grad_comm="hier", elastic="on")
+    with pytest.raises(ValueError):
+        Config(grad_comm_wire="int2")
+    with pytest.raises(ValueError):
+        Config(hier_hosts=-1)
+
+
+def test_mesh_batch_axes():
+    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import data_mesh
+
+    assert mesh_batch_axes(data_mesh()) == "data"
+    assert mesh_batch_axes(hier_mesh(jax.devices(), 2)) == ("host", "device")

@@ -157,6 +157,69 @@ def test_lock_env_propagates_through_call_sites():
     assert analyze_source(src) == []
 
 
+def test_lock_env_propagates_through_recursion_cycles():
+    """PR-12 satellite (carried since PR 10): a recursive callee whose every
+    EXTERNAL call site holds the lock is proven guarded — the in-cycle
+    caller starts unknown (⊤) and must act as intersection identity, not
+    pin the whole cycle at 'no locks'. Both a self-recursive method and a
+    two-function cycle."""
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _drain(self, n):\n"
+        "        self._x = n\n"
+        "        if n:\n"
+        "            self._drain(n - 1)\n"
+        "    def _ping(self, n):\n"
+        "        self._x = n\n"
+        "        self._pong(n)\n"
+        "    def _pong(self, n):\n"
+        "        if n:\n"
+        "            self._ping(n - 1)\n"
+        "    def _run(self):\n"
+        "        with self._lock:\n"
+        "            self._drain(3)\n"
+        "    def poke(self):\n"
+        "        with self._lock:\n"
+        "            self._drain(2)\n"
+        "            self._ping(2)\n"
+        "            self._x = 9\n"
+    )
+    proj = Project.from_summaries([summarize_source(src, "s.py")])
+    graph = CallGraph(proj)
+    assert "_lock" in graph.lock_env["s::S._drain"]
+    assert "_lock" in graph.lock_env["s::S._ping"]
+    assert "_lock" in graph.lock_env["s::S._pong"]
+    # and the guarded-everywhere verdict silences G012 on self._x
+    assert analyze_source(src) == []
+
+
+def test_lock_env_recursion_requires_external_guard():
+    """The cycle inherits only what EVERY external entry holds: an unlocked
+    entry into the cycle strips the env (soundness of the greatest
+    fixpoint — optimism must not invent locks)."""
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _drain(self, n):\n"
+        "        if n:\n"
+        "            self._drain(n - 1)\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            self._drain(2)\n"
+        "    def bare(self):\n"
+        "        self._drain(1)\n"
+    )
+    proj = Project.from_summaries([summarize_source(src, "s.py")])
+    graph = CallGraph(proj)
+    assert graph.lock_env["s::S._drain"] == frozenset()
+
+
 def test_spawn_edge_does_not_propagate_locks():
     """Thread(target=...) started under a lock does NOT hold it."""
     src = (

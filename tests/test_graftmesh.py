@@ -58,6 +58,13 @@ def model_of(src: str, path: str = "m.py") -> MeshModel:
         # (ISSUE 11 satellite: the window controller stores plan-derived
         # sizes on `self` and packs columns into lists)
         ("g016_attr_violation.py", "G016", 3),
+        # axis-param override channel must EXTEND the universe, not disarm
+        # the rule (PR-12 satellite fixture pair)
+        ("g014_override_violation.py", "G014", 1),
+        # per-executable-key registered-lowering matching: a spec
+        # registered for executable B must not sanction a mismatched
+        # placement dispatched to executable A (PR-12 satellite)
+        ("g015_key_violation.py", "G015", 1),
     ],
 )
 def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -72,12 +79,90 @@ def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings)
 
 @pytest.mark.parametrize(
     "fixture",
-    ["g014_clean.py", "g015_clean.py", "g016_clean.py", "g016_attr_clean.py"],
+    [
+        "g014_clean.py",
+        "g015_clean.py",
+        "g016_clean.py",
+        "g016_attr_clean.py",
+        "g014_override_clean.py",
+        "g015_key_clean.py",
+    ],
 )
 def test_clean_fixture_is_quiet(fixture):
     path = str(FIXTURES / fixture)
     assert analyze_paths([path]) == []
     assert lint_file(path) == []
+
+
+def test_axis_param_override_extends_universe_and_value_env():
+    """PR-12 satellite: a call-site literal override of a DEFAULTED axis
+    param must enter the axis universe AND the bound mesh's value
+    environment — previously invisible, so every collective over the
+    override axis was a false G014."""
+    src = (
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def build(devices, axis='data'):\n"
+        "    return Mesh(np.array(devices), (axis,))\n"
+        "def use(devices):\n"
+        "    mesh = build(devices, axis='model')\n"
+        "    return mesh\n"
+    )
+    model = model_of(src)
+    assert model.axis_universe == {"data", "model"}
+    assert model.axis_universe_complete
+    fn = model.project.functions["m::use"]
+    assert model.mesh_axes_of_token(fn, "mesh") == {"model"}
+    # the callee's own default-resolved return is unchanged
+    assert model.mesh_returns["m::build"] == frozenset({"data"})
+
+
+def test_two_level_axis_universe_and_tuple_collectives():
+    """ISSUE 12: the (host, device) factorization is modeled — the hier
+    mesh helper's constants enter the universe, and a tuple-literal
+    collective axis (``psum(x, ("host", "device"))``, the two-level
+    combine's spelling) demands BOTH member axes."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "HOST_AXIS = 'host'\n"
+        "DEVICE_AXIS = 'device'\n"
+        "def hier_mesh(devices, hosts, host_axis=HOST_AXIS,"
+        " device_axis=DEVICE_AXIS):\n"
+        "    arr = np.array(devices)\n"
+        "    return Mesh(arr, (host_axis, device_axis))\n"
+        "def combine(tree):\n"
+        "    return jax.lax.psum(tree, ('host', 'device'))\n"
+        "def hop(v):\n"
+        "    return jax.lax.psum(v, 'host')\n"
+    )
+    model = model_of(src)
+    assert {"host", "device"} <= model.axis_universe
+    assert model.required_axes["m::combine"] == {"host", "device"}
+    assert model.required_axes["m::hop"] == {"host"}
+
+
+def test_g015_key_scoping_narrows_but_falls_back_class_wide():
+    """Per-executable-key matching: key literals are harvested only from
+    registry-call tuple arguments, a keyed dispatch checks against its own
+    key's scopes, and a key-less dispatch keeps the class-wide union."""
+    from dynamic_load_balance_distributeddnn_tpu.analysis.flow.mesh import (
+        RuleG015,
+    )
+
+    viol = (FIXTURES / "g015_key_violation.py").read_text()
+    clean = (FIXTURES / "g015_key_clean.py").read_text()
+    proj = Project.from_summaries([summarize_source(viol, "v.py")])
+    lits = RuleG015._key_literals(
+        [proj.functions["v::Engine._submit_fused"]]
+    )
+    assert lits == {"fused"}
+    assert RuleG015._key_literals(
+        [proj.functions["v::Engine._dispatch_fused"]]
+    ) == {"fused"}
+    assert [f.code for f in analyze_source(viol)] == ["G015"]
+    assert analyze_source(clean) == []
 
 
 def test_g015_flags_restore_onto_old_mesh_across_boundary():

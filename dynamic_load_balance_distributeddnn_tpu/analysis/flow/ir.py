@@ -321,6 +321,11 @@ class BindFact:
     # (``d[k] = v`` -> "d"): element mutation, not a rebind — taint unions
     # into the container instead of replacing it (G016)
     sub_targets: Tuple[str, ...] = ()
+    # RHS is a tuple/list/string literal of axis entries (same encoding as
+    # SpecCtor.axes: literal string, "$token", or "?") — the channel that
+    # lets graftmesh resolve VARIABLE collective-axis arguments
+    # (``axes = (H, D); psum(x, axes)``); None for any other RHS
+    rhs_axes: Optional[Tuple[Optional[str], ...]] = None
 
 
 @dataclass(frozen=True)
@@ -777,6 +782,17 @@ class _FunctionLowerer:
         value: Optional[ast.expr] = None
         if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
             value = stmt.value
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            # Dict-VALUE iteration binds the loop targets to the dict's
+            # ELEMENTS: ``for v in d.values()`` / ``for k, v in d.items()``
+            # must propagate d's taint into v (the last recorded graftflow
+            # modeling gap — G016's per-device column dicts iterate this
+            # way). Other iterables keep the opaque-fresh-binding model.
+            it = stmt.iter
+            if isinstance(it, ast.Call) and _attr_tail(
+                call_name(it) or ""
+            ) in ("values", "items"):
+                value = it
         if value is None:
             # For/With targets: fresh bindings with opaque sources
             return BindFact(
@@ -797,6 +813,16 @@ class _FunctionLowerer:
             if is_jit_construction(value):
                 donate = literal_int_tuple(jit_kwarg(value, "donate_argnums")) or ()
             spec = spec_ctor(value)
+        # Axis-tuple literal RHS (``axes = ("host", "device")`` or with
+        # constant members): recorded so graftmesh can resolve a VARIABLE
+        # collective-axis argument through the local bind (the G014
+        # axis-tuple-variable gap). "?" entries keep the errs-quiet
+        # contract downstream.
+        rhs_axes: Optional[Tuple[Optional[str], ...]] = None
+        if isinstance(value, (ast.Tuple, ast.List)) or (
+            isinstance(value, ast.Constant) and isinstance(value.value, str)
+        ):
+            rhs_axes = _axes_tuple(value)
         return BindFact(
             targets=tuple(targets),
             line=stmt.lineno,
@@ -808,6 +834,7 @@ class _FunctionLowerer:
             donate_argnums=donate,
             spec=spec,
             sub_targets=tuple(sub_targets),
+            rhs_axes=rhs_axes,
         )
 
     def _ret_fact(self, stmt: ast.Return) -> RetFact:

@@ -664,6 +664,42 @@ class MeshModel:
             r = self.resolve_axis_entry(e, fn)
             if r is not None:
                 out.append(r)
+            elif e.startswith("$"):
+                out.extend(
+                    self._local_axis_tuple(fn, e[1:], call.line)
+                )
+        return out
+
+    def _local_axis_tuple(
+        self, fn: FunctionSummary, tok: str, at_line: int
+    ) -> List[str]:
+        """Axis names a LOCAL variable holds at a collective's use site,
+        resolved through its tuple/string-literal bind (``axes = ("host",
+        "device"); psum(x, axes)`` — the spelling the hier combine's
+        ``self._axis_arg`` sites lower to once inlined). Only literals and
+        constant members resolve; an attribute- or call-valued bind (or a
+        later opaque rebind) returns nothing — the errs-quiet contract.
+        The LAST bind before ``at_line`` wins."""
+        if "." in tok:
+            return []
+        out: List[str] = []
+        for stmt in fn.stmts:
+            if stmt.line >= at_line:
+                break
+            bind = stmt.bind
+            if bind is None or tok not in bind.targets:
+                continue
+            if bind.rhs_axes is None:
+                out = []  # rebound to something opaque: forget the tuple
+                continue
+            resolved: List[str] = []
+            for e in bind.rhs_axes:
+                r = self.resolve_axis_entry(e, fn)
+                if r is None:
+                    resolved = []
+                    break
+                resolved.append(r)
+            out = resolved
         return out
 
     # ------------------------------------------------------- spec value env

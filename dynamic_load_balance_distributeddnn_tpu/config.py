@@ -230,8 +230,10 @@ class Config:
                                        # to 127 levels (shared pmax scale,
                                        # stochastic rounding — unbiased, no
                                        # error feedback needed), summed in
-                                       # int16: half the wire bytes. Opt-in,
-                                       # fused path only.
+                                       # int16: half the wire bytes. Opt-in;
+                                       # fused paths, and with shard_update
+                                       # the ZeRO-1 reduce-scatter rides the
+                                       # same wire (PR 13).
     grad_accum: int = 1                # fused-path micro-batching: each step's
                                        # per-device batch is processed in this
                                        # many scanned slices, grads summed
@@ -240,11 +242,26 @@ class Config:
                                        # memory / grad_accum. Absent in the
                                        # reference (SURVEY §2.5).
     shard_update: bool = False         # cross-replica weight-update sharding
-                                       # (ZeRO-1 analogue): fused path
-                                       # reduce-scatters grads, updates a 1/n
-                                       # momentum shard per chip, all-gathers
-                                       # the delta — optimizer memory / n_dev.
-                                       # Uniform-plan (dbs off) runs only.
+                                       # (ZeRO-1 analogue), generic over
+                                       # optax transforms since PR 13:
+                                       # reduce-scatter grads, tx.update on
+                                       # the 1/n flat opt-state chunk,
+                                       # all-gather the delta — optimizer
+                                       # memory / n_dev. Composes with the
+                                       # fused paths, the elastic DBS
+                                       # dispatch (zero-1 combine twins),
+                                       # elastic world size (chunks re-shard
+                                       # onto the survivor mesh),
+                                       # compress_grads (quantized
+                                       # reduce-scatter) and grad_comm=hier
+                                       # (the in-host RS + compressed DCN
+                                       # hop). Excluded: scan-mode
+                                       # supersteps and packed epochs fall
+                                       # back to windowed dispatch, and
+                                       # non-elementwise transforms (global-
+                                       # norm clipping INSIDE tx) are out of
+                                       # contract — the per-worker grad_clip
+                                       # runs before the combine and is fine.
     stream_chunk_steps: int = 128      # host data path streams the epoch in
                                        # windows of this many steps (gather +
                                        # device_put of window k+1 overlaps
@@ -521,12 +538,6 @@ class Config:
                 "already rides --grad_comm_wire (the flat int8 collective "
                 "stays available via compress_grads with grad_comm=flat)"
             )
-        if self.grad_comm == "hier" and self.shard_update:
-            raise ValueError(
-                "grad_comm=hier and shard_update are not composed yet: the "
-                "ZeRO-1 reduce_scatter must learn to ride the quantized "
-                "wire (tracked in ROADMAP)"
-            )
         if self.grad_comm == "hier" and self.elastic == "on":
             raise ValueError(
                 "grad_comm=hier's two-level mesh cannot survive an elastic "
@@ -580,12 +591,6 @@ class Config:
                 "dispatch paths; the fused-DBS whole-epoch scan has no "
                 "window boundary to act at"
             )
-        if self.elastic == "on" and self.shard_update:
-            raise ValueError(
-                "elastic world size re-places a REPLICATED state across a "
-                "changed mesh; shard_update's mesh-sharded optimizer leaves "
-                "cannot survive a re-shard yet"
-            )
         if self.trace not in ("on", "off", "ring"):
             raise ValueError("trace must be 'on', 'off' or 'ring'")
         if self.trace_ring < 1:
@@ -604,18 +609,10 @@ class Config:
                 "keeps exact f32 gradients); enable fused_dbs to combine it "
                 "with the balancer"
             )
-        if self.compress_grads and self.shard_update:
-            raise ValueError("compress_grads and shard_update are exclusive")
         if self.grad_accum > 1 and self.dynamic_batch_size and not self.fused_dbs:
             raise ValueError(
                 "grad_accum rides a fused path; the elastic DBS path controls "
                 "memory by shrinking per-worker batches instead"
-            )
-        if self.shard_update and self.dynamic_batch_size and not self.fused_dbs:
-            raise ValueError(
-                "shard_update rides a fused path; combine it with the "
-                "balancer via fused_dbs (the elastic DBS path keeps the "
-                "replicated update)"
             )
 
     def straggler_factors(self) -> List[float]:
@@ -723,8 +720,10 @@ def get_parser() -> argparse.ArgumentParser:
                    help="Fused-path micro-batching factor (activation memory "
                         "/ N, grads summed before the collective; exact).")
     p.add_argument("--shard_update", type=str2bool, default=d.shard_update,
-                   help="ZeRO-1-style sharded optimizer update on the fused path "
-                        "(reduce_scatter grads / shard momentum / all_gather delta).")
+                   help="ZeRO-1-style sharded optimizer update, generic over "
+                        "optax transforms (reduce_scatter grads / tx.update "
+                        "on the 1/n chunk / all_gather delta); composes "
+                        "with elastic, hier and the quantized wires.")
     p.add_argument("--stream_chunk_steps", type=int, default=d.stream_chunk_steps,
                    help="Stream the host data path in windows of N steps "
                         "(prefetch overlaps compute); 0 = materialize whole epochs.")

@@ -30,6 +30,50 @@ from dynamic_load_balance_distributeddnn_tpu.obs.recorder import MetricsRecorder
 from dynamic_load_balance_distributeddnn_tpu.obs.trace import Tracer, get_tracer
 
 
+def device_peak_memory() -> Dict:
+    """Per-device peak-memory series (ISSUE 13 satellite) — the datum the
+    zero1 A/B reports. Where the backend provides ``device.memory_stats()``
+    (TPU/GPU runtimes), one row per local device with ``bytes_in_use`` and
+    ``peak_bytes_in_use``; CPU backends expose no per-device allocator, so
+    the fallback reports the process's peak RSS (and tracemalloc's peak
+    when tracing is active) — a coarser but honest host-side ceiling."""
+    import jax
+
+    out: Dict = {"source": "memory_stats", "per_device": []}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without an allocator API
+            stats = None
+        if stats:
+            out["per_device"].append(
+                {
+                    "device": str(d),
+                    "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(
+                        stats.get(
+                            "peak_bytes_in_use", stats.get("bytes_in_use", 0)
+                        )
+                    ),
+                }
+            )
+    if not out["per_device"]:
+        import resource
+        import sys
+        import tracemalloc
+
+        out["source"] = "host_rss"
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KiB on Linux, bytes on macOS
+        out["host_peak_rss_bytes"] = int(
+            ru if sys.platform == "darwin" else ru * 1024
+        )
+        if tracemalloc.is_tracing():
+            _cur, peak = tracemalloc.get_traced_memory()
+            out["tracemalloc_peak_bytes"] = int(peak)
+    return out
+
+
 class MetricsRegistry:
     def __init__(
         self,
@@ -90,6 +134,10 @@ class MetricsRegistry:
         if comm:
             comm["grad_comm"] = self.recorder.meta.get("grad_comm", "flat")
             out["comm"] = comm
+        # per-device peak-memory series (ISSUE 13): backend allocator stats
+        # where available, host-RSS fallback on CPU — what the zero1 A/B
+        # cites for the optimizer-state shrink
+        out["memory"] = device_peak_memory()
         if self.host_meter is not None:
             m = self.host_meter
             out["host"] = {

@@ -121,6 +121,21 @@ def mesh_batch_axes(mesh: Mesh) -> Union[str, tuple]:
     return names[0] if len(names) == 1 else names
 
 
+def zero1_chunk_axes(mesh: Mesh) -> Union[str, tuple]:
+    """The PartitionSpec entry for a ZeRO-1 1/n optimizer chunk's flat
+    vector: the data axis on a flat mesh; on a two-level mesh the
+    ``(device, host)`` tuple — DEVICE-major, the reverse of the batch
+    entry. The hierarchical sharded update produces exactly this block
+    order: the in-host reduce-scatter gives device d the d-th 1/D slice,
+    and the cross-host hop's re-split hands host h the h-th sub-slice of
+    it, so device (h, d) owns flat block ``d*H + h`` — which is what a dim
+    split ``(device, host)``-major means."""
+    names = tuple(mesh.axis_names)
+    if len(names) == 1:
+        return names[0]
+    return (names[1], names[0])
+
+
 def probe_link_bandwidth(
     mesh: Mesh, floats_per_device: int = 1 << 18, reps: int = 3, tracer=None
 ) -> Dict[str, object]:

@@ -135,6 +135,40 @@ def hier_tree_allreduce(
     return unravel(out[:t_real]), new_residual
 
 
+def compressed_reduce_scatter(
+    v: jnp.ndarray,
+    key,
+    axis: AxisName,
+    n_participants: int,
+    wire: str,
+) -> jnp.ndarray:
+    """One compressed reduce-scatter hop over ``axis`` (inside shard_map):
+    the ZeRO-1 sharded update's gradient collective riding the quantized
+    wire (PR-12 follow-up). Quantize with the shared ``pmax`` scale,
+    reduce-scatter the integer payload in the wire's sum dtype — the same
+    bytes-per-element shrink as :func:`compressed_reduce`, on 1/n of the
+    tensor per link — and dequantize this participant's chunk of the sum.
+    ``v``'s leading dim must divide by the axis size (the caller's ZeRO-1
+    padding guarantees it). The int8 wire's stochastic rounding keeps the
+    scattered sum unbiased exactly like the all-reduce hop."""
+    if wire == "fp32":
+        return jax.lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)
+    levels = _LEVELS[wire]
+    amax = jax.lax.pmax(jnp.max(jnp.abs(v)), axis)
+    scale = jnp.maximum(amax / levels, jnp.finfo(jnp.float32).tiny)
+    if wire == "int8":
+        q = quantize_stochastic(v, key, scale, levels)
+    else:
+        q = quantize_nearest(v, scale, levels)
+    s = jax.lax.psum_scatter(
+        q.astype(wire_sum_dtype(wire, n_participants)),
+        axis,
+        scatter_dimension=0,
+        tiled=True,
+    )
+    return s.astype(jnp.float32) * scale
+
+
 def compressed_reduce(
     v: jnp.ndarray,
     key,

@@ -140,11 +140,20 @@ def save_checkpoint(
 
 
 def restore_checkpoint(
-    ckpt_dir: str, state_template
+    ckpt_dir: str, state_template, template_fn=None
 ) -> Optional[Tuple[int, Any, Dict[str, Any]]]:
     """Returns (last_saved_epoch, state, controller) or None if absent.
     ``state_template`` is a live TrainState with the target shapes/shardings
-    (the freshly initialized one)."""
+    (the freshly initialized one).
+
+    ``template_fn``: optional ``controller_sidecar -> template-or-None``
+    hook, consulted BEFORE the orbax restore. Needed by the elastic ZeRO-1
+    composition (ISSUE 13): a checkpoint taken at a reduced fleet carries
+    1/N optimizer chunks padded to the SURVIVOR device count's multiple, so
+    the fresh full-world template's shapes would not match the saved
+    arrays — the engine rebuilds a template at the saved fleet size from
+    the sidecar's ``active_ranks`` stamp, restores into it, then re-chunks
+    through the ordinary reshard path."""
     import orbax.checkpoint as ocp
 
     if not os.path.isdir(ckpt_dir):
@@ -157,6 +166,15 @@ def restore_checkpoint(
     step = mgr.latest_step()
     if step is None:
         return None
+    if template_fn is not None:
+        side_pre = os.path.join(ckpt_dir, f"controller_{step}.json")
+        sidecar: Dict[str, Any] = {}
+        if os.path.exists(side_pre):
+            with open(side_pre) as f:
+                sidecar = json.load(f)
+        adjusted = template_fn(sidecar)
+        if adjusted is not None:
+            state_template = adjusted
     abstract = jax.tree_util.tree_map(
         ocp.utils.to_shape_dtype_struct, state_template
     )

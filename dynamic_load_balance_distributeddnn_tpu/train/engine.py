@@ -263,12 +263,15 @@ class Trainer:
         # AOT-key / plan-layout signature of the combine structure: a new
         # axis factorization or wire format is a new compiled-program
         # universe, so it participates in every registry key the combine
-        # and fused executables are filed under.
+        # and fused executables are filed under. Since PR 13 the UPDATE
+        # SPEC (sharded vs replicated optimizer) is part of the same
+        # signature — a zero-1 program and a replicated one lower from
+        # different state specs and must never resolve to each other.
         self._comm_sig = (
             ("hier", cfg.grad_comm_wire, self._hier_hosts)
             if self.grad_comm == "hier"
             else ("flat",)
-        )
+        ) + (("zero1",) if cfg.shard_update else ())
 
         self._setup_data(bundle)
         self._setup_model()
@@ -595,20 +598,29 @@ class Trainer:
             seed=cfg.seed,
             sharding=replicated_sharding(self.mesh),
         )
+        self._zero1_padded = 0
         if cfg.shard_update:
             from dynamic_load_balance_distributeddnn_tpu.train.state import (
                 shard_optimizer_state,
+                zero1_padded_size,
             )
 
-            self.state = shard_optimizer_state(self.state, self.mesh, cfg.momentum)
+            self._zero1_padded = zero1_padded_size(self.state.params, self.n_dev)
+            self.state = shard_optimizer_state(self.state, self.mesh, self.tx)
         if self.grad_comm == "hier":
             from dynamic_load_balance_distributeddnn_tpu.train.state import (
                 attach_comm_residual,
             )
 
             # zero error-feedback residual, [n_dev, chunk] one row per
-            # device over the two-level mesh; checkpoints restore into it
-            self.state = attach_comm_residual(self.state, self.mesh)
+            # device over the two-level mesh; checkpoints restore into it.
+            # With shard_update the residual chunk follows the ZERO-1
+            # padding (a multiple of the TOTAL device count, so the
+            # post-hop chunk re-splits evenly across hosts).
+            self.state = attach_comm_residual(
+                self.state, self.mesh,
+                pad_multiple=self.n_dev if cfg.shard_update else 0,
+            )
         self._build_steps()
 
     def _build_steps(self) -> None:
@@ -635,6 +647,7 @@ class Trainer:
             remat=cfg.remat,
             grad_comm=self.grad_comm,
             grad_comm_wire=cfg.grad_comm_wire,
+            zero1_padded=getattr(self, "_zero1_padded", 0),
         )
         if getattr(self, "_aot", None) is not None:
             self.steps.aot_service = self._aot
@@ -712,10 +725,14 @@ class Trainer:
 
     def _combine_names(self) -> "tuple[str, str]":
         """(update, probe) combine executable names for the active combine
-        structure — the hier twins ride the two-level mesh, the flat pair
-        the single psum."""
+        structure — the hier twins ride the two-level mesh (routing into
+        the sharded update internally when shard_update is on), the zero-1
+        twins the flat mesh with a sharded update, the flat pair the single
+        psum plus replicated update."""
         if self.grad_comm == "hier":
             return ("combine_update_hier", "combine_probe_hier")
+        if self.cfg.shard_update:
+            return ("combine_update_zero1", "combine_probe_zero1")
         return ("combine_update", "combine_probe")
 
     def _aot_view_spec(self, d: int):
@@ -1514,12 +1531,63 @@ class Trainer:
             },
         )
 
+    def _zero1_restore_template(self, sidecar: dict):
+        """Restore template matching a checkpoint saved at a REDUCED fleet
+        (elastic × shard_update): the saved 1/N optimizer chunks are padded
+        to the survivor device count's multiple, so the fresh full-world
+        template's flat shapes would mismatch. Rebuild the opt-state chunk
+        leaves at the saved padding (replicated placement — addressable for
+        the restore; the post-restore reshard re-chunks). None = the stamp
+        matches the current fleet, keep the ordinary template."""
+        saved_active = sidecar.get("active_ranks")
+        if saved_active is None:
+            return None
+        # same validity gate as _maybe_restore's adopt branch, applied
+        # BEFORE indexing: a stamp from a different world_size (stale dir,
+        # re-configured resume) must fall back to the ordinary template,
+        # not crash the restore
+        if not all(
+            isinstance(r, (int, float)) and 0 <= int(r) < self.cfg.world_size
+            for r in saved_active
+        ):
+            return None
+        from dynamic_load_balance_distributeddnn_tpu.train.state import (
+            zero1_padded_size,
+            zero1_param_count,
+        )
+
+        local_devices = sorted(jax.local_devices(), key=lambda d: d.id)
+        ids_global = self.cfg.worker_device_ids(len(local_devices))
+        n_dev_saved = len({ids_global[int(r)] for r in saved_active})
+        saved_padded = zero1_padded_size(self.state.params, n_dev_saved)
+        if saved_padded == self._zero1_padded:
+            return None
+        total = zero1_param_count(self.state.params)
+        rep = replicated_sharding(self.mesh)
+
+        def resize(leaf):
+            if not (hasattr(leaf, "ndim") and leaf.ndim >= 1):
+                return leaf
+            if leaf.shape[0] < total:
+                return leaf
+            shape = (saved_padded,) + tuple(leaf.shape[1:])
+            return jax.device_put(jnp.zeros(shape, leaf.dtype), rep)
+
+        return self.state.replace(
+            opt_state=jax.tree_util.tree_map(resize, self.state.opt_state)
+        )
+
     def _maybe_restore(self) -> int:
         from dynamic_load_balance_distributeddnn_tpu.train.checkpoint import (
             restore_checkpoint,
         )
 
-        restored = restore_checkpoint(self.cfg.ckpt_dir, self.state)
+        template_fn = None
+        if self.cfg.elastic == "on" and self.cfg.shard_update:
+            template_fn = self._zero1_restore_template
+        restored = restore_checkpoint(
+            self.cfg.ckpt_dir, self.state, template_fn=template_fn
+        )
         if restored is None:
             return 0
         epoch, state, controller = restored
@@ -1805,11 +1873,58 @@ class Trainer:
 
     def _state_from_host(self, snap: tuple):
         """Rebuild the TrainState from a host snapshot onto the CURRENT
-        mesh (replicated — elastic excludes shard_update by config)."""
+        mesh. Replicated leaves re-place directly; with shard_update on,
+        the flat 1/N optimizer chunks re-chunk for the (possibly changed)
+        survivor mesh STRAIGHT from the host arrays — unpad to the true
+        parameter count, re-pad to the new device-count multiple
+        (:attr:`_zero1_padded`, set by _reshard_world), place 1/N-sharded
+        (the host-side all_gather→re-split of the reshard boundary; the
+        snapshot already materialized the full vector). Placing them
+        replicated first would transiently hold the FULL optimizer state
+        on every device — the exact memory shard_update exists to avoid.
+        The generation-keyed AOT registry (``_aot_gen`` in every key)
+        guarantees no stale zero-1 executable can resolve against the
+        re-chunked layout."""
         host, treedef = snap
         sh = replicated_sharding(self.mesh)
+        chunk_idx: set = set()
+        chunked_sh = None
+        total = new_padded = 0
+        if self.cfg.shard_update:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+                zero1_chunk_axes,
+            )
+
+            # identify the flat-init chunk vectors by TREE POSITION (the
+            # opt_state subtree) + the leading-dim convention of
+            # state.py shard_optimizer_state — scalars/hyperparams are 0-d
+            idx_tree = jax.tree_util.tree_unflatten(
+                treedef, list(range(len(host)))
+            )
+            total = int(
+                sum(host[i][0].size
+                    for i in jax.tree_util.tree_leaves(idx_tree.params))
+            )
+            new_padded = self._zero1_padded
+            chunk_idx = {
+                i
+                for i in jax.tree_util.tree_leaves(idx_tree.opt_state)
+                if host[i][0].ndim >= 1 and host[i][0].shape[0] >= total
+            }
+            chunked_sh = NamedSharding(
+                self.mesh, P(zero1_chunk_axes(self.mesh))
+            )
         leaves = []
-        for val, committed, weak in host:
+        for i, (val, committed, weak) in enumerate(host):
+            if i in chunk_idx:
+                v = val[:total]
+                v = np.pad(
+                    v, [(0, new_padded - total)] + [(0, 0)] * (v.ndim - 1)
+                )
+                leaves.append(jax.device_put(jnp.array(v, copy=True), chunked_sh))
+                continue
             if weak and val.ndim == 0:
                 leaf = jnp.asarray(val.item())
             else:
@@ -1854,6 +1969,17 @@ class Trainer:
         mesh_devices = list(self.topology.devices)
         self.mesh = data_mesh(mesh_devices)
         self.n_dev = len(mesh_devices)
+        if cfg.shard_update:
+            # the 1/N optimizer chunk layout is sized by the DEVICE count:
+            # a survivor fleet re-pads the flat state to its own multiple
+            # (the _state_from_host re-chunk consumes this)
+            from dynamic_load_balance_distributeddnn_tpu.train.state import (
+                zero1_padded_size,
+            )
+
+            self._zero1_padded = zero1_padded_size(
+                self.state.params, self.n_dev
+            )
         self._build_steps()
         # mesh/topology-keyed caches: all stale the moment the fleet changed
         self._aot_gen += 1
@@ -2236,11 +2362,15 @@ class Trainer:
         graftscope's ``train`` phase. Returns ``(train_metrics,
         ran_elastic)``."""
         cfg = self.cfg
+        # shard_update composes with the elastic dispatch since PR 13 (the
+        # zero-1 combine twins); grad_accum stays fused-only, and the flat
+        # compressed psum does too UNLESS the sharded update carries it
+        # (the quantized reduce-scatter lives inside _zero1_update)
         if (
-            cfg.shard_update or cfg.grad_accum > 1 or cfg.compress_grads
+            cfg.grad_accum > 1 or (cfg.compress_grads and not cfg.shard_update)
         ) and not (self._can_use_fused(plan) or self._can_use_fused_dbs(plan)):
             raise RuntimeError(
-                "shard_update/grad_accum/compress_grads require a fused path "
+                "grad_accum/compress_grads require a fused path "
                 "(one worker per device); this plan fell back to the elastic "
                 "path"
             )
@@ -3205,10 +3335,20 @@ class Trainer:
         call (on-device step indexing) instead of ~5 host-issued dispatches.
 
         ``"step"`` — the legacy per-step loop (superstep="off"), kept as the
-        bitwise-parity and dispatch-overhead reference."""
+        bitwise-parity and dispatch-overhead reference.
+
+        shard_update excludes scan mode: the superstep body applies the
+        tree-level replicated update inside its scan, which the flat-chunk
+        sharded opt state cannot feed — those topologies run windowed (the
+        per-step zero-1 combine twin is an identity-collective on the
+        single-device mesh, so the math is unchanged)."""
         if self.cfg.superstep == "off":
             return "step"
-        if self.topology.single_group and self.n_proc == 1:
+        if (
+            self.topology.single_group
+            and self.n_proc == 1
+            and not self.cfg.shard_update
+        ):
             return "scan"
         return "window"
 

@@ -95,6 +95,19 @@ class LMTrainer(Trainer):
             seed=cfg.seed,
             sharding=replicated_sharding(self.mesh),
         )
+        self._zero1_padded = 0
+        if cfg.shard_update:
+            # ZeRO-1 sharded update on the LM path (ISSUE 13): identical
+            # flat-chunk conversion and combine-twin dispatch as the vision
+            # engine — the update shard stays uniform even though the LM's
+            # column batches are not
+            from dynamic_load_balance_distributeddnn_tpu.train.state import (
+                shard_optimizer_state,
+                zero1_padded_size,
+            )
+
+            self._zero1_padded = zero1_padded_size(self.state.params, self.n_dev)
+            self.state = shard_optimizer_state(self.state, self.mesh, self.tx)
         if self.grad_comm == "hier":
             from dynamic_load_balance_distributeddnn_tpu.train.state import (
                 attach_comm_residual,
@@ -103,7 +116,10 @@ class LMTrainer(Trainer):
             # hierarchical combine (ISSUE 12): the LM's elastic dispatch
             # rides the hier combine twins like the vision path — the
             # error-feedback residual travels in the TrainState
-            self.state = attach_comm_residual(self.state, self.mesh)
+            self.state = attach_comm_residual(
+                self.state, self.mesh,
+                pad_multiple=self.n_dev if cfg.shard_update else 0,
+            )
         grad_clip = cfg.grad_clip if cfg.grad_clip > 0 else 0.25  # dbs.py:274
         self.steps = StepLibrary(
             self.spec,
@@ -112,10 +128,13 @@ class LMTrainer(Trainer):
             grad_clip=grad_clip,
             compute_dtype=jnp.bfloat16 if cfg.precision == "bfloat16" else None,
             use_pallas=cfg.use_pallas,
+            shard_update=cfg.shard_update,
             grad_accum=cfg.grad_accum,
+            compress_grads=cfg.compress_grads,
             remat=cfg.remat,
             grad_comm=self.grad_comm,
             grad_comm_wire=cfg.grad_comm_wire,
+            zero1_padded=self._zero1_padded,
         )
 
     def _dummy_batch(self, b: int):

@@ -6,15 +6,18 @@ per-epoch learning-rate override for the one-cycle policy (dbs.py:369,
 rate lives *in the optimizer state* and can be set per epoch without
 recompiling the update step.
 
-State is replicated over the data mesh: every device holds the full params
-and momentum, as every reference worker does (dbs.py:365-369). (Sharding the
-optimizer state ZeRO-style is an available upgrade; the mesh machinery does
-not foreclose it.)
+State is replicated over the data mesh by default: every device holds the
+full params and momentum, as every reference worker does (dbs.py:365-369).
+With ``--shard_update`` the optimizer state is converted to the GENERIC
+ZeRO-1 form (:func:`shard_optimizer_state`): the transform is
+re-initialized on the flat padded parameter vector so every param-shaped
+state piece becomes one 1/n-sharded chunk vector — any elementwise optax
+transform, not just the SGD twin the pre-PR-13 path hand-rolled.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, Optional
 
 import flax.struct
 import jax
@@ -63,78 +66,106 @@ def make_optimizer(learning_rate: float, momentum: float = 0.9) -> optax.Gradien
     )
 
 
-class ShardedSGDState(NamedTuple):
-    """SGD(momentum) state with the momentum buffer FLAT and SHARDED over the
-    data mesh — cross-replica weight-update sharding (the TPU-native ZeRO-1
-    analogue, after arXiv 2004.13336): each replica reduce-scatters gradients,
-    updates only its 1/n shard of the momentum, and all-gathers the weight
-    delta. Memory for optimizer state drops n_dev-fold; the update math is
-    identical to the replicated ``optax.sgd``.
-
-    Mimics ``inject_hyperparams``' state surface (``hyperparams`` dict +
-    ``_replace``) so ``TrainState.with_learning_rate`` and the one-cycle
-    schedule work unchanged."""
-
-    hyperparams: dict          # {"learning_rate": scalar} — replicated
-    momentum: jnp.ndarray      # scalar decay factor — replicated
-    trace: jnp.ndarray         # [padded_total] flat momentum, P('data')-sharded
-    count: jnp.ndarray         # step counter
+def zero1_param_count(params) -> int:
+    """Raveled parameter element count — ``ravel_pytree``'s flat size is
+    exactly the sum of leaf sizes, so count leaves instead of materializing
+    a flattened copy."""
+    return int(sum(p.size for p in jax.tree_util.tree_leaves(params)))
 
 
-def shard_optimizer_state(state: TrainState, mesh, momentum: float = 0.9) -> TrainState:
-    """Convert a replicated-optax TrainState into the sharded-update form:
-    the momentum trace becomes one flat zero vector (padded to a mesh-size
-    multiple) sharded over the data axis. Fresh-start conversion (trace is
-    zero at init, like the reference's SGD, dbs.py:369)."""
+def zero1_padded_size(params, n_shards: int) -> int:
+    """Flat parameter count padded up to a multiple of the shard count —
+    the single padding convention every ZeRO-1 site (state conversion,
+    update math, reshard re-chunk, residual sizing) must share."""
+    total = zero1_param_count(params)
+    return -(-total // max(n_shards, 1)) * max(n_shards, 1)
+
+
+def shard_optimizer_state(
+    state: TrainState, mesh, tx: optax.GradientTransformation
+) -> TrainState:
+    """Convert a replicated-optax TrainState into the sharded-update form —
+    GENERIC over optax transforms (the PR-13 tentpole): the optimizer is
+    re-initialized on the FLAT padded parameter vector, so every
+    param-shaped piece of its state (sgd's trace, adam's mu/nu) becomes one
+    [padded_total] vector sharded 1/n over the mesh, while scalar leaves
+    (inject_hyperparams' lr, adam's count) stay replicated. The update math
+    is then the elementwise transform applied to this device's chunk —
+    identical per element to the replicated per-leaf update (the uniform
+    update shard of arXiv 2004.13336). Exactness holds for ELEMENTWISE
+    transforms (sgd/momentum, adam(w), rmsprop, any chain of scale_by_*);
+    transforms that reduce over the whole tree inside ``tx`` (e.g.
+    clip_by_global_norm) would see only the chunk and are excluded — the
+    engine's per-worker grad clip runs before the combine and composes
+    fine.
+
+    The inject_hyperparams state surface (``.hyperparams`` + ``._replace``)
+    survives the conversion untouched, so ``with_learning_rate`` and the
+    one-cycle schedule work unchanged. The chunk layout follows
+    :func:`~..parallel.mesh.zero1_chunk_axes`: ``P('data')`` on a flat
+    mesh, ``P(('device','host'))`` on a two-level one — device-major, the
+    block order the hierarchical in-host reduce-scatter + cross-host hop
+    produces."""
     import jax.flatten_util  # noqa: F401  (registers the submodule)
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS
+    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+        zero1_chunk_axes,
+    )
 
     flat, _ = jax.flatten_util.ravel_pytree(state.params)
     n = len(mesh.devices.flat)
-    padded = -(-flat.size // n) * n
-    trace = jax.device_put(
-        jnp.zeros((padded,), jnp.float32), NamedSharding(mesh, P(DATA_AXIS))
-    )
+    padded = zero1_padded_size(state.params, n)
+    flat = jnp.pad(flat.astype(jnp.float32), (0, padded - flat.size))
+    opt_state = tx.init(flat)
+    # carry forward any already-applied hyperparam overrides (a state that
+    # saw with_learning_rate before conversion) — tx.init re-reads factory
+    # defaults
+    old_hp = getattr(state.opt_state, "hyperparams", None)
+    if old_hp is not None and hasattr(opt_state, "hyperparams"):
+        hp = dict(opt_state.hyperparams)
+        for k, v in old_hp.items():
+            if k in hp:
+                hp[k] = jnp.asarray(v, jnp.float32)
+        opt_state = opt_state._replace(hyperparams=hp)
+    chunked = NamedSharding(mesh, P(zero1_chunk_axes(mesh)))
     # Scalars committed REPLICATED over the mesh (not default-device): this
     # state doubles as the restore template, and a single-device-committed
     # leaf would clash with the mesh-wide jit after checkpoint resume.
     rep = NamedSharding(mesh, P())
-    opt_state = ShardedSGDState(
-        hyperparams={
-            "learning_rate": jax.device_put(
-                jnp.asarray(
-                    state.opt_state.hyperparams["learning_rate"], jnp.float32
-                ),
-                rep,
-            )
-        },
-        momentum=jax.device_put(jnp.asarray(momentum, jnp.float32), rep),
-        trace=trace,
-        count=jax.device_put(jnp.zeros((), jnp.int32), rep),
+    opt_state = jax.tree_util.tree_map(
+        lambda l: jax.device_put(
+            l, chunked if (l.ndim >= 1 and l.shape[0] == padded) else rep
+        ),
+        opt_state,
     )
     return state.replace(opt_state=opt_state)
 
 
-def residual_chunk_size(params, devices_per_host: int) -> int:
+def residual_chunk_size(
+    params, devices_per_host: int, pad_multiple: int = 0
+) -> int:
     """Per-device error-feedback chunk width: the raveled param count padded
     up to a multiple of the in-host device count (the reduce-scatter's
-    divisibility requirement) divided by it. ravel_pytree's flat size is
-    exactly the sum of leaf sizes, so count leaves instead of
-    materializing a full flattened copy at init. Must match the
-    hierarchical combine's padding arithmetic (parallel/wire.py
-    hier_tree_allreduce)."""
-    total = int(sum(p.size for p in jax.tree_util.tree_leaves(params)))
-    padded = -(-total // devices_per_host) * devices_per_host
+    divisibility requirement) — or of ``pad_multiple`` when the ZeRO-1
+    layout co-rides the combine (the sharded update pads to the TOTAL
+    device count so the post-hop chunk re-splits evenly across hosts) —
+    divided by the in-host count. Must match the hierarchical combine's
+    padding arithmetic (parallel/wire.py hier_tree_allreduce and the
+    sharded twin in train/steps.py)."""
+    total = zero1_param_count(params)
+    mult = max(pad_multiple, devices_per_host)
+    padded = -(-total // mult) * mult
     return padded // devices_per_host
 
 
-def attach_comm_residual(state: TrainState, mesh) -> TrainState:
+def attach_comm_residual(state: TrainState, mesh, pad_multiple: int = 0) -> TrainState:
     """Attach a zero error-feedback residual sized for ``mesh``'s two-level
     factorization: [n_devices, chunk] f32, one row per device (leading axis
-    split over BOTH mesh axes, row-major — the flat device order). Fresh
-    runs start at zero error by definition; checkpoint restore replaces the
+    split over BOTH mesh axes, row-major — the flat device order).
+    ``pad_multiple``: the ZeRO-1 total-device padding when the sharded
+    update rides the wire (see :func:`residual_chunk_size`). Fresh runs
+    start at zero error by definition; checkpoint restore replaces the
     zeros with the saved residual through the ordinary state template."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -142,7 +173,9 @@ def attach_comm_residual(state: TrainState, mesh) -> TrainState:
     if len(names) != 2:
         raise ValueError("attach_comm_residual needs a two-level (host, device) mesh")
     n = int(np.prod(tuple(mesh.shape.values())))
-    chunk = residual_chunk_size(state.params, int(mesh.shape[names[1]]))
+    chunk = residual_chunk_size(
+        state.params, int(mesh.shape[names[1]]), pad_multiple
+    )
     residual = jax.device_put(
         jnp.zeros((n, chunk), jnp.float32), NamedSharding(mesh, P(names))
     )

@@ -42,7 +42,7 @@ from dynamic_load_balance_distributeddnn_tpu.ops.losses import (
     per_example_nll,
 )
 from dynamic_load_balance_distributeddnn_tpu.parallel import wire as wirefmt
-from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import DATA_AXIS, shard_map
+from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import shard_map
 from dynamic_load_balance_distributeddnn_tpu.train.state import TrainState
 
 
@@ -83,6 +83,7 @@ class StepLibrary:
         remat: bool = False,
         grad_comm: str = "flat",
         grad_comm_wire: str = "int8",
+        zero1_padded: int = 0,
     ):
         self.spec = spec
         self.mesh = mesh
@@ -103,11 +104,6 @@ class StepLibrary:
                 "(parallel/mesh.py hier_mesh); the engine resolves the "
                 "factorization and falls back to flat when none exists"
             )
-        if self.hier and shard_update:
-            raise ValueError(
-                "grad_comm='hier' with shard_update is not composed yet "
-                "(ROADMAP: let the ZeRO-1 reduce_scatter ride the wire)"
-            )
         self.mean = mean
         self.std = std
         self.augment = augment
@@ -117,10 +113,36 @@ class StepLibrary:
         # backward, f32 master weights + f32 loss/grad accumulation
         self.compute_dtype = compute_dtype
         # Cross-replica weight-update sharding (ZeRO-1 analogue, arXiv
-        # 2004.13336): fused path reduce-scatters gradients, updates a 1/n
-        # momentum shard, all-gathers the weight delta. Requires the state's
-        # opt_state to be a ShardedSGDState (train/state.py).
+        # 2004.13336), generic over optax transforms since PR 13: gradients
+        # reduce-scatter into 1/n flat chunks (optionally on the quantized
+        # wire, or through the hierarchical ICI/DCN spine), tx.update runs
+        # on the chunk against the flat-init sharded opt state
+        # (train/state.py shard_optimizer_state), and the update delta
+        # all-gathers back. ``zero1_padded`` is the flat padded parameter
+        # count the engine computed at state conversion — the opt-state
+        # spec and the update math key off it.
         self.shard_update = shard_update
+        self.zero1_padded = int(zero1_padded)
+        if shard_update and self.zero1_padded <= 0:
+            raise ValueError(
+                "shard_update needs zero1_padded (the flat padded parameter "
+                "count from train/state.py zero1_padded_size)"
+            )
+        # State donation is DISABLED under the sharded update — a
+        # correctness sanction, not a tuning choice: donating a carry that
+        # holds the inject_hyperparams opt state miscompiles on XLA:CPU
+        # (jax 0.4.37) — the wrapper's pass-through/astype'd hyperparam
+        # outputs let the backend alias carry buffers it also donated, and
+        # the SECOND invocation of the executable reads freed memory (nan
+        # params, then heap corruption at teardown; reproduced
+        # deterministically on fused_epoch, graph-shape dependent —
+        # optimization_barrier fences moved the miscompile around instead
+        # of killing it, so the sanction is categorical: no donated state
+        # buffers, no freed-buffer aliasing). Cost: one transient extra
+        # copy of params + the 1/n opt chunks per dispatch — the
+        # steady-state optimizer memory the feature exists to shrink is
+        # unaffected.
+        self._state_donate: tuple = () if shard_update else (0,)
         # Micro-batching inside the fused step (lax.scan over batch slices,
         # grads summed before the collective) — exact under per-example
         # weighting; activation memory scales with batch/grad_accum.
@@ -141,6 +163,34 @@ class StepLibrary:
         # supersteps never populate the lazy jit caches.
         self.aot_service = None
         self._build()
+
+    @classmethod
+    def zero1_shell(
+        cls,
+        mesh: Mesh,
+        tx: optax.GradientTransformation,
+        zero1_padded: int,
+        *,
+        hier: bool = False,
+        wire: str = "fp32",
+        compress: str = "",
+    ) -> "StepLibrary":
+        """A minimal library exposing ONLY the ZeRO-1 update spine —
+        ``_zero1_update`` + ``_state_spec`` with no model plumbing — for
+        the zero1 A/B bench and the parity tests. Owned HERE so the set of
+        attributes the spine reads lives next to the spine: drift breaks
+        at this factory, not at bench time."""
+        lib = cls.__new__(cls)
+        lib.mesh = mesh
+        lib.axes = tuple(mesh.axis_names)
+        lib.hier = hier
+        lib.tx = tx
+        lib.shard_update = True
+        lib.zero1_padded = int(zero1_padded)
+        lib.compress_grads = compress
+        lib.grad_comm_wire = wire
+        lib._state_donate = ()
+        return lib
 
     def _apply_train(self, params, x, rng):
         apply = lambda p, xx: self.spec.module.apply(  # noqa: E731
@@ -445,15 +495,20 @@ class StepLibrary:
             n += self.aot_service.count_keys(("group_superstep",))
         return n
 
-    # ------------------------------------------- hierarchical combine twins
-    # (elastic dispatch, ISSUE 12): drop-in replacements for combine_update
-    # / combine_probe when the two-level mesh is active. Each device sums
-    # its own [1, ...] slice of the stacked partials, then the combine runs
-    # the same reduce-scatter / compressed-DCN-hop / all-gather spine as the
-    # fused body — three collectives total for the whole tree — with the
-    # error-feedback residual carried through the TrainState.
+    # --------------------------------------- sharded-state combine twins
+    # (elastic dispatch, ISSUEs 12/13): drop-in replacements for
+    # combine_update / combine_probe when the combine itself must run
+    # inside a shard_map body — the two-level hier spine, and/or the
+    # ZeRO-1 sharded update (whose opt-state chunks and reduce-scatter are
+    # per-device by construction). Each device sums its own [1, ...] slice
+    # of the stacked partials, then the body routes: sharded update when
+    # shard_update is on (the zero-1 math internally rides the hier spine
+    # or the quantized flat wire as configured), else the hier
+    # reduce-scatter / compressed-DCN-hop / all-gather plus the replicated
+    # update — with the error-feedback residual carried through the
+    # TrainState either way.
 
-    def _hier_combine_body(self, state: TrainState, stacked):
+    def _sharded_combine_body(self, state: TrainState, stacked):
         local = jax.tree_util.tree_map(lambda g: jnp.sum(g, axis=0), stacked)
         rng = jax.random.fold_in(
             jax.random.fold_in(
@@ -461,6 +516,8 @@ class StepLibrary:
             ),
             state.step,
         )
+        if self.shard_update:
+            return self._zero1_update(state, local, rng, with_comm=True)
         grads, new_residual = self._hier_combine(
             local, rng, state.comm_residual
         )
@@ -473,27 +530,43 @@ class StepLibrary:
             comm_residual=new_residual,
         )
 
-    def _hier_combine_twin(self, donate: bool):
+    def _sharded_combine_twin(self, donate: bool):
         sharded = shard_map(
-            self._hier_combine_body,
+            self._sharded_combine_body,
             mesh=self.mesh,
             in_specs=(self._state_spec(), P(self._batch_entry)),
             out_specs=self._state_spec(),
             check_vma=False,
         )
         if donate:
-            return jax.jit(sharded, donate_argnums=(0, 1))
+            # the stacked partials (argnum 1) always donate; the state only
+            # donates on the replicated-update (hier) twins — see the
+            # _state_donate sanction in __init__
+            return jax.jit(
+                sharded, donate_argnums=self._state_donate + (1,)
+            )
         return jax.jit(sharded)
 
     @functools.cached_property
     def combine_update_hier(self):
-        return self._hier_combine_twin(donate=True)
+        return self._sharded_combine_twin(donate=True)
 
     @functools.cached_property
     def combine_probe_hier(self):
         """Non-donating twin for timing probes (inputs stay valid, result —
         including the would-be residual update — is discarded)."""
-        return self._hier_combine_twin(donate=False)
+        return self._sharded_combine_twin(donate=False)
+
+    @functools.cached_property
+    def combine_update_zero1(self):
+        """Flat-mesh ZeRO-1 combine twin (shard_update without hier): the
+        same shard_map spine as the hier twins, with the body routed into
+        the sharded update."""
+        return self._sharded_combine_twin(donate=True)
+
+    @functools.cached_property
+    def combine_probe_zero1(self):
+        return self._sharded_combine_twin(donate=False)
 
     # ------------------------------------------------------- AOT lowerables
     # The executable families the async compile service can pre-compile,
@@ -512,9 +585,13 @@ class StepLibrary:
         if self.hier:
             # hier combine twins exist only on the two-level mesh (building
             # them on a flat mesh would trace collectives over axes the
-            # mesh does not define)
+            # mesh does not define); with shard_update on they ARE the
+            # sharded-update twins (the body routes)
             out["combine_update_hier"] = self.combine_update_hier
             out["combine_probe_hier"] = self.combine_probe_hier
+        elif self.shard_update:
+            out["combine_update_zero1"] = self.combine_update_zero1
+            out["combine_probe_zero1"] = self.combine_probe_zero1
         out.update(self._aot_lowerables_base())
         return out
 
@@ -610,16 +687,46 @@ class StepLibrary:
         )
         return out, new_residual[None]
 
+    @functools.cached_property
+    def _opt_state_spec(self):
+        """Per-leaf shard_map spec pytree of the GENERIC flat-init sharded
+        optimizer state (train/state.py shard_optimizer_state): leaves
+        whose leading dim is the padded flat parameter count are the 1/n
+        chunks (split over the zero-1 chunk axes — device-major on a
+        two-level mesh), everything else (inject_hyperparams' lr, adam's
+        count) is replicated. Derived from ``tx.init``'s abstract shapes so
+        arbitrary optax transforms spec themselves."""
+        from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+            zero1_chunk_axes,
+        )
+
+        padded = self.zero1_padded
+        ax = zero1_chunk_axes(self.mesh)
+        abs_state = jax.eval_shape(
+            self.tx.init, jax.ShapeDtypeStruct((padded,), jnp.float32)
+        )
+        return jax.tree_util.tree_map(
+            lambda l: P(ax) if (l.ndim >= 1 and l.shape[0] == padded) else P(),
+            abs_state,
+        )
+
     def _state_spec(self):
         """shard_map spec for the TrainState: fully replicated, except the
-        flat momentum trace when weight-update sharding is on (prefix-spec
-        pytree: ``params=P()`` covers the whole params subtree) and the
-        per-device error-feedback residual on hierarchical runs."""
+        flat 1/n optimizer chunks when weight-update sharding is on
+        (prefix-spec pytree: ``params=P()`` covers the whole params
+        subtree) and the per-device error-feedback residual on
+        hierarchical runs."""
         from dynamic_load_balance_distributeddnn_tpu.train.state import (
-            ShardedSGDState,
             TrainState as TS,
         )
 
+        if self.shard_update:
+            return TS(
+                params=P(),
+                opt_state=self._opt_state_spec,
+                step=P(),
+                comm_residual=P(self._batch_entry) if self.hier else P(),
+            )
         if self.hier:
             return TS(
                 params=P(),
@@ -627,19 +734,7 @@ class StepLibrary:
                 step=P(),
                 comm_residual=P(self._batch_entry),
             )
-        if not self.shard_update:
-            return P()
-        return TS(
-            params=P(),
-            opt_state=ShardedSGDState(
-                hyperparams={"learning_rate": P()},
-                momentum=P(),
-                trace=P(DATA_AXIS),
-                count=P(),
-            ),
-            step=P(),
-            comm_residual=P(),
-        )
+        return P()
 
     def _fused_shard_body(self, state, x, y, w, slow_scalar, seed, with_comm=True):
         """Per-device body of the fused SPMD step: local grad, optional
@@ -714,7 +809,9 @@ class StepLibrary:
         probe = synthetic_load(slow_scalar, wloss)
         metrics = jnp.stack([wloss, loss_sum, count, probe])
         if self.shard_update:
-            state = self._zero1_update(state, grads, with_comm)
+            state = self._zero1_update(
+                state, grads, jax.random.fold_in(rng, 0x7FFF), with_comm
+            )
             if with_comm:
                 metrics = jax.lax.psum(metrics, self._axis_arg)
             return state, metrics
@@ -756,45 +853,112 @@ class StepLibrary:
             out.append(total.astype(g.dtype))
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    def _zero1_update(self, state, local_grads, with_comm: bool):
-        """Sharded SGD(momentum) update: reduce_scatter local grads, update
-        this device's 1/n momentum shard, all_gather the weight delta
-        (identical math to ``optax.sgd``: t' = g + mu*t; p' = p - lr*t').
-        ``with_comm=False`` builds the comm-free probe twin: same FLOPs shape,
-        collectives replaced by local slices/pads (output is discarded)."""
+    def _zero1_update(self, state, local_grads, rng, with_comm: bool):
+        """Generic sharded optimizer update (ZeRO-1 analogue, arXiv
+        2004.13336) over an ARBITRARY optax transform: ravel the gradient
+        tree ONCE, reduce-scatter into this device's 1/n chunk, run
+        ``tx.update`` on the chunk against the chunked opt state and the
+        matching flat param chunk (param-dependent transforms — adamw's
+        weight decay — see exactly their slice), all-gather the update
+        delta, apply. Exact for elementwise transforms — identical per
+        element to the replicated per-leaf update (the update shard is
+        uniform even when data shards are not, which is why this composes
+        with DBS).
+
+        Wire composition (PR-12 follow-up): on the two-level mesh the
+        reduce-scatter becomes the full-precision in-host reduce-scatter
+        plus ONE compressed cross-host hop on ``grad_comm_wire`` with the
+        error-feedback residual carried per-chunk; each host then keeps its
+        1/H sub-slice, so the chunk layout is device-major
+        (parallel/mesh.py zero1_chunk_axes). On the flat mesh,
+        ``compress_grads='int8'`` rides the quantized reduce-scatter
+        (parallel/wire.py compressed_reduce_scatter). ``with_comm=False``
+        builds the comm-free probe twin: same FLOPs shape, collectives
+        replaced by local slices/pads (output is discarded)."""
         import jax.flatten_util
 
         opt = state.opt_state
         n = len(self.mesh.devices.flat)
         flat_g, unravel = jax.flatten_util.ravel_pytree(local_grads)
         t_real = flat_g.size
-        padded = -(-t_real // n) * n
+        # the ctor-validated padding is THE convention (train/state.py
+        # zero1_padded_size) — recomputing it here could silently diverge
+        # from the state conversion's chunk layout
+        padded = self.zero1_padded
+        assert padded % n == 0 and padded >= t_real, (padded, n, t_real)
         flat_g = jnp.pad(flat_g, (0, padded - t_real))
         chunk = padded // n
-        if with_comm:
-            g_chunk = jax.lax.psum_scatter(
-                flat_g, DATA_AXIS, scatter_dimension=0, tiled=True
-            )
+        new_residual = state.comm_residual
+        key = jax.random.fold_in(rng, 0x2E01)
+        if self.hier:
+            h_ax, d_ax = self.axes
+            n_h = int(self.mesh.shape[h_ax])
+            h = jax.lax.axis_index(h_ax)
+            d = jax.lax.axis_index(d_ax)
+            off = (d * n_h + h) * chunk
+            if with_comm:
+                # in-host reduce-scatter at full precision over ICI: device
+                # d holds the summed-in-host d-th 1/D slice [chunk_d]
+                g_cd = jax.lax.psum_scatter(
+                    flat_g, d_ax, scatter_dimension=0, tiled=True
+                )
+                res = (
+                    state.comm_residual[0]
+                    if state.comm_residual is not None
+                    else 0.0
+                )
+                v = g_cd + res
+                total, sent = wirefmt.compressed_reduce(
+                    v, key, h_ax, n_h, self.grad_comm_wire
+                )
+                new_residual = (v - sent)[None]
+                # re-split across hosts: host h owns the h-th 1/H sub-slice
+                # of the fully reduced chunk — flat block (d*H + h)*chunk
+                g_chunk = jax.lax.dynamic_slice(total, (h * chunk,), (chunk,))
+            else:
+                g_chunk = jax.lax.dynamic_slice(flat_g, (off,), (chunk,))
         else:
-            idx = jax.lax.axis_index(DATA_AXIS)
-            g_chunk = jax.lax.dynamic_slice(flat_g, (idx * chunk,), (chunk,))
-        new_trace = g_chunk + opt.momentum * opt.trace
-        delta_chunk = opt.hyperparams["learning_rate"] * new_trace
+            off = self._data_axis_index() * chunk
+            if with_comm:
+                if self.compress_grads == "int8":
+                    g_chunk = wirefmt.compressed_reduce_scatter(
+                        flat_g, key, self._axis_arg, n, "int8"
+                    )
+                else:
+                    g_chunk = jax.lax.psum_scatter(
+                        flat_g, self._axis_arg, scatter_dimension=0, tiled=True
+                    )
+            else:
+                g_chunk = jax.lax.dynamic_slice(flat_g, (off,), (chunk,))
+        flat_p, _ = jax.flatten_util.ravel_pytree(state.params)
+        flat_p = jnp.pad(flat_p.astype(jnp.float32), (0, padded - t_real))
+        p_chunk = jax.lax.dynamic_slice(flat_p, (off,), (chunk,))
+        updates_chunk, opt_state = self.tx.update(g_chunk, opt, p_chunk)
         if with_comm:
-            delta = jax.lax.all_gather(delta_chunk, DATA_AXIS, tiled=True)
+            if self.hier:
+                # gather back in layout order: hosts first (rebuilds the
+                # in-host chunk_d), then devices (rebuilds the flat vector)
+                delta = jax.lax.all_gather(
+                    jax.lax.all_gather(updates_chunk, h_ax, tiled=True),
+                    d_ax,
+                    tiled=True,
+                )
+            else:
+                delta = jax.lax.all_gather(
+                    updates_chunk, self._axis_arg, tiled=True
+                )
         else:
-            idx = jax.lax.axis_index(DATA_AXIS)
             delta = jax.lax.dynamic_update_slice(
-                jnp.zeros((padded,), delta_chunk.dtype), delta_chunk, (idx * chunk,)
+                jnp.zeros((padded,), updates_chunk.dtype), updates_chunk, (off,)
             )
         params = jax.tree_util.tree_map(
-            lambda p, d: p - d.reshape(p.shape).astype(p.dtype),
+            lambda p, u: p + u.reshape(p.shape).astype(p.dtype),
             state.params,
             unravel(delta[:t_real]),
         )
-        opt_state = opt._replace(trace=new_trace, count=opt.count + 1)
         return state.replace(
-            params=params, opt_state=opt_state, step=state.step + 1
+            params=params, opt_state=opt_state, step=state.step + 1,
+            comm_residual=new_residual,
         )
 
     @functools.cached_property
@@ -814,7 +978,7 @@ class StepLibrary:
             out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
-        return jax.jit(sharded, donate_argnums=(0,))
+        return jax.jit(sharded, donate_argnums=self._state_donate)
 
     @functools.cached_property
     def fused_epoch(self):
@@ -847,7 +1011,7 @@ class StepLibrary:
             out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
-        return jax.jit(sharded, donate_argnums=(0,))
+        return jax.jit(sharded, donate_argnums=self._state_donate)
 
     @functools.cached_property
     def fused_epoch_idx(self):
@@ -883,7 +1047,7 @@ class StepLibrary:
             out_specs=(self._state_spec(), P()),
             check_vma=False,
         )
-        return jax.jit(sharded, donate_argnums=(0,))
+        return jax.jit(sharded, donate_argnums=self._state_donate)
 
     def _fused_probe(self, with_comm: bool):
         """Non-donating single-step twin of ``fused_step`` for timing probes.

@@ -1,0 +1,30 @@
+"""G014 negatives for the axis-tuple-variable resolver: collectives over
+variables bound to tuples/strings of DEFINED axes (directly or through
+module constants) stay quiet, and an opaque rebind (attribute-valued)
+keeps the errs-quiet contract."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+HOST = "host"
+DEVICE = "device"
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices).reshape(2, -1), (HOST, DEVICE))
+
+
+def combine(tree):
+    axes = (HOST, DEVICE)  # both defined, resolved through constants
+    return jax.lax.psum(tree, axes)
+
+
+def in_host(x):
+    ax = DEVICE  # string variable of a defined axis (constant alias bind)
+    return jax.lax.psum(x, (ax,))
+
+
+def opaque(self_like, x):
+    axes = self_like.batch_axes  # attribute-valued: stays unresolved/quiet
+    return jax.lax.psum(x, axes)

@@ -1,0 +1,34 @@
+"""G014 seeds: axis-TUPLE VARIABLES in collective axis args (PR-13
+satellite). The two-level combine spells its collectives over a variable
+bound to an axis tuple; before the local-bind resolver those spellings
+erred quiet, so a typo'd member axis (or a stale string variable) was
+invisible.
+
+Shape 1: ``combine`` psums over ``axes = (HOST, "devicee")`` — the tuple
+resolves through the local bind and the constant, exposing the member typo
+no mesh defines.
+
+Shape 2: ``index`` reads ``axis_index(ax)`` where ``ax = "dat"`` — a
+string VARIABLE naming an axis no mesh construction defines.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+HOST = "host"
+DEVICE = "device"
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices).reshape(2, -1), (HOST, DEVICE))
+
+
+def combine(tree):
+    axes = (HOST, "devicee")  # typo'd member, hidden behind a variable
+    return jax.lax.psum(tree, axes)
+
+
+def index(x):
+    ax = "dat"  # no mesh defines 'dat'
+    return jax.lax.axis_index(ax) + x

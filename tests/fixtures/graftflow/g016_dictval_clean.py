@@ -1,0 +1,44 @@
+"""G016 negatives for the dict-VALUE iteration channel: the SAME staging
+dict and ``.values()`` / ``.items()`` loops, but every stored column passed
+the pad/quantize discipline first — ladder widths a collective can legally
+see."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))
+
+
+def integer_batch_split(shares, global_batch):
+    return np.maximum((shares * global_batch).astype(np.int64), 1)
+
+
+def quantize_batches(batches, bucket, global_batch):
+    return np.maximum(batches // bucket, 1) * bucket
+
+
+def stack_values(parts, shares, global_batch, bucket, pad_to):
+    batches = quantize_batches(
+        integer_batch_split(shares, global_batch), bucket, global_batch
+    )
+    cols = {}
+    for r in range(len(parts)):
+        cols[r] = np.pad(parts[r], (0, pad_to - len(parts[r])))  # padded
+    out = []
+    for v in cols.values():
+        out.append(v)
+    return jnp.stack(out), batches
+
+
+def gather_items(parts, pad_to):
+    cols = {}
+    for r in range(len(parts)):
+        cols[r] = np.pad(parts[r], (0, pad_to - len(parts[r])))
+    gathered = []
+    for r, v in cols.items():
+        gathered.append(jax.lax.all_gather(v, "data"))
+    return gathered

@@ -1,0 +1,48 @@
+"""G016 seeds: plan taint through dict-VALUE iteration (PR-13 satellite).
+
+The engine's dispatch loops stage per-worker columns in dicts; iterating
+``d.values()`` / ``d.items()`` hands each ELEMENT onward — before the
+For-iter modeling, the loop target was an opaque fresh binding and the
+taint chain broke exactly there.
+
+Shape 1: raw plan widths stored into a dict, re-collected through
+``.values()`` and stacked on device.
+
+Shape 2: the ``.items()`` tuple-target spelling, feeding a fixed-shape
+collective directly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data",))
+
+
+def integer_batch_split(shares, global_batch):
+    return np.maximum((shares * global_batch).astype(np.int64), 1)
+
+
+def stack_values(parts, shares, global_batch):
+    batches = integer_batch_split(shares, global_batch)
+    cols = {}
+    for r in range(len(parts)):
+        cols[r] = parts[r][: batches[r]]  # raw plan widths
+    out = []
+    for v in cols.values():  # taint crosses the dict-VALUE iteration
+        out.append(v)
+    return jnp.stack(out)
+
+
+def gather_items(parts, shares, global_batch):
+    batches = integer_batch_split(shares, global_batch)
+    cols = {}
+    for r in range(len(parts)):
+        cols[r] = parts[r][: batches[r]]
+    gathered = []
+    for r, v in cols.items():  # the tuple-target spelling
+        gathered.append(jax.lax.all_gather(v, "data"))
+    return gathered

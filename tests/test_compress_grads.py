@@ -78,10 +78,12 @@ def test_compressed_training_tracks_exact(tmp_path):
     np.testing.assert_allclose(quant, exact, rtol=0.08)
 
 
-def test_compress_rejects_dbs_and_shard_update():
+def test_compress_rejects_dbs_composes_with_shard_update():
     with pytest.raises(ValueError):
         Config(debug=True, dynamic_batch_size=True, compress_grads="int8",
                model="mnistnet", dataset="mnist")
-    with pytest.raises(ValueError):
-        Config(debug=True, dynamic_batch_size=False, compress_grads="int8",
-               shard_update=True, model="mnistnet", dataset="mnist")
+    # compress x shard_update composes since PR 13: the ZeRO-1
+    # reduce-scatter rides the quantized wire
+    cfg = Config(debug=True, dynamic_batch_size=False, compress_grads="int8",
+                 shard_update=True, model="mnistnet", dataset="mnist")
+    assert cfg.compress_grads == "int8" and cfg.shard_update

@@ -316,8 +316,11 @@ def test_probe_link_bandwidth_reports_phases():
 
 
 def test_config_guards():
-    with pytest.raises(ValueError):
-        Config(grad_comm="hier", shard_update=True, dynamic_batch_size=False)
+    # hier x shard_update composes since PR 13 (the ZeRO-1 reduce-scatter
+    # rides the in-host RS + compressed DCN hop)
+    assert Config(
+        grad_comm="hier", shard_update=True, dynamic_batch_size=False
+    ).shard_update
     with pytest.raises(ValueError):
         Config(grad_comm="hier", compress_grads="int8", fused_dbs=True)
     with pytest.raises(ValueError):

@@ -65,6 +65,13 @@ def model_of(src: str, path: str = "m.py") -> MeshModel:
         # registered for executable B must not sanction a mismatched
         # placement dispatched to executable A (PR-12 satellite)
         ("g015_key_violation.py", "G015", 1),
+        # axis-tuple VARIABLES in collective axis args resolve through the
+        # local bind — the hier combine's self._axis_arg class of
+        # spellings no longer errs quiet (PR-13 satellite)
+        ("g014_tuplevar_violation.py", "G014", 2),
+        # plan taint through dict-VALUE iteration (.values() / .items()
+        # tuple targets) — the last recorded modeling gap (PR-13 satellite)
+        ("g016_dictval_violation.py", "G016", 2),
     ],
 )
 def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -86,6 +93,8 @@ def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings)
         "g016_attr_clean.py",
         "g014_override_clean.py",
         "g015_key_clean.py",
+        "g014_tuplevar_clean.py",
+        "g016_dictval_clean.py",
     ],
 )
 def test_clean_fixture_is_quiet(fixture):
@@ -115,6 +124,39 @@ def test_axis_param_override_extends_universe_and_value_env():
     assert model.mesh_axes_of_token(fn, "mesh") == {"model"}
     # the callee's own default-resolved return is unchanged
     assert model.mesh_returns["m::build"] == frozenset({"data"})
+
+
+def test_axis_tuple_variable_resolves_through_local_bind():
+    """PR-13 satellite: a collective whose axis argument is a VARIABLE
+    bound to a tuple (or string) literal resolves through the local bind —
+    constants in the tuple resolve too; attribute-valued binds and later
+    opaque rebinds stay unresolved (errs quiet)."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "H = 'host'\n"
+        "def make(devices):\n"
+        "    return Mesh(np.array(devices), (H, 'device'))\n"
+        "def combine(x):\n"
+        "    axes = (H, 'device')\n"
+        "    return jax.lax.psum(x, axes)\n"
+        "def strvar(x):\n"
+        "    ax = 'host'\n"
+        "    return jax.lax.axis_index(ax) + x\n"
+        "def opaque(obj, x):\n"
+        "    axes = obj.batch_axes\n"
+        "    return jax.lax.psum(x, axes)\n"
+        "def rebound(obj, x):\n"
+        "    axes = (H,)\n"
+        "    axes = obj.batch_axes\n"
+        "    return jax.lax.psum(x, axes)\n"
+    )
+    model = model_of(src)
+    assert model.required_axes["m::combine"] == {"host", "device"}
+    assert model.required_axes["m::strvar"] == {"host"}
+    assert model.required_axes["m::opaque"] == set()
+    assert model.required_axes["m::rebound"] == set()  # rebind forgets
 
 
 def test_two_level_axis_universe_and_tuple_collectives():

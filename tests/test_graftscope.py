@@ -247,6 +247,16 @@ def test_registry_snapshot_unifies_surfaces():
     assert snap["host"]["put_s"] == 0.25
     assert snap["trace"]["mode"] == "off"
     assert {"total", "foreground", "background"} <= set(snap["compiles"])
+    # per-device peak-memory series (ISSUE 13): allocator stats where the
+    # backend has them, host-RSS fallback on this CPU tier either way
+    mem = snap["memory"]
+    assert mem["source"] in ("memory_stats", "host_rss")
+    if mem["source"] == "memory_stats":
+        assert mem["per_device"] and all(
+            m["peak_bytes_in_use"] >= 0 for m in mem["per_device"]
+        )
+    else:
+        assert mem["host_peak_rss_bytes"] > 0
     # the facade honors the None-for-absent contract and rejects typo'd slots
     assert reg.last("mfu_bf16_peak") is None
     assert reg.series("examples_per_s") == [100.0]

@@ -55,9 +55,26 @@ def test_sharded_update_matches_replicated(bundle):
     )
 
 
+def _chunk_leaves(state):
+    """The flat-init 1/n chunk vectors of the generic sharded opt state
+    (every opt leaf with a non-scalar leading dim — see state.py)."""
+    import jax
+
+    from dynamic_load_balance_distributeddnn_tpu.train.state import (
+        zero1_param_count,
+    )
+
+    total = zero1_param_count(state.params)
+    return [
+        l
+        for l in jax.tree_util.tree_leaves(state.opt_state)
+        if hasattr(l, "ndim") and l.ndim >= 1 and l.shape[0] >= total
+    ]
+
+
 def test_trace_is_sharded_over_mesh(bundle):
     tr, _ = _run(bundle, shard=True)
-    trace = tr.state.opt_state.trace
+    (trace,) = _chunk_leaves(tr.state)  # sgd-momentum: one trace vector
     n_dev = len(tr.mesh.devices.flat)
     assert trace.ndim == 1 and trace.shape[0] % n_dev == 0
     shards = trace.addressable_shards
@@ -68,10 +85,13 @@ def test_trace_is_sharded_over_mesh(bundle):
     assert float(np.abs(np.asarray(trace)).max()) > 0
 
 
-def test_shard_update_rejects_dbs():
-    with pytest.raises(ValueError):
-        Config(debug=True, dynamic_batch_size=True, shard_update=True,
-               model="mnistnet", dataset="mnist")
+def test_shard_update_composes_with_dbs():
+    """PR 13 lifted the fused-only guard: shard_update now rides the
+    elastic DBS dispatch through the zero-1 combine twins (and still the
+    fused-DBS capacity scan via fused_dbs)."""
+    cfg = Config(debug=True, dynamic_batch_size=True, shard_update=True,
+                 model="mnistnet", dataset="mnist")
+    assert cfg.shard_update and cfg.dynamic_batch_size
 
 
 @pytest.mark.slow
@@ -94,7 +114,7 @@ def test_sharded_state_checkpoint_roundtrip(bundle, tmp_path):
     )
     tr = Trainer(cfg, bundle=bundle, log_to_file=False)
     tr.run()
-    trace_after = np.asarray(tr.state.opt_state.trace)
+    trace_after = np.asarray(_chunk_leaves(tr.state)[0])
 
     from dynamic_load_balance_distributeddnn_tpu.train.checkpoint import (
         restore_checkpoint,
@@ -108,8 +128,8 @@ def test_sharded_state_checkpoint_roundtrip(bundle, tmp_path):
     step, restored, _ = restore_checkpoint(cfg.ckpt_dir, tr2.state)
     assert step == 0
     np.testing.assert_allclose(
-        np.asarray(restored.opt_state.trace), trace_after, rtol=1e-6
+        np.asarray(_chunk_leaves(restored)[0]), trace_after, rtol=1e-6
     )
     tr2.run()  # resumes: runs only epoch 1
     assert list(tr2.recorder.data["epoch"]) == [1]
-    assert len(tr2.state.opt_state.trace.addressable_shards) == 8
+    assert len(_chunk_leaves(tr2.state)[0].addressable_shards) == 8

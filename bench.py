@@ -730,6 +730,25 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
 
     if (
         force_cpu
+        and os.environ.get("BENCH_ELASTIC_MH_AB", "1") == "1"
+        and "elastic_mh_recovery_ab" not in out["instr"]
+    ):
+        if resume.get("instr", {}).get("elastic_mh_recovery_ab"):
+            out["instr"]["elastic_mh_recovery_ab"] = resume["instr"][
+                "elastic_mh_recovery_ab"
+            ]
+        else:
+            try:
+                out["instr"]["elastic_mh_recovery_ab"] = (
+                    _elastic_mh_recovery_ab()
+                )
+            except Exception as e:
+                sys.stderr.write(f"[bench] elastic_mh_recovery_ab failed: {e}\n")
+                out["instr"]["elastic_mh_recovery_ab"] = {"error": str(e)[:300]}
+        _write_atomic(out_path, out)
+
+    if (
+        force_cpu
         and os.environ.get("BENCH_ONLINE_AB", "1") == "1"
         and "online_dbs_ab" not in out["instr"]
     ):
@@ -1299,6 +1318,118 @@ def run_grad_comm_worker(proc_id: int, num_procs: int, port: int) -> int:
     if proc_id == 0:
         print("RESULT " + json.dumps(res), flush=True)
     return 0
+
+
+def _elastic_mh_recovery_ab() -> dict:
+    """Multi-host elasticity chaos leg (ISSUE 14 acceptance field
+    ``elastic_mh_recovery_ab``): a REAL two-process rendezvous run
+    (tests/_mh_worker.py, DBS_MH_RDZV mode — 2 procs × 2 virtual CPU
+    devices, ws=4) where the parent SIGKILLs one peer at its epoch-1
+    marker. The survivor detects the loss (collective-failure attribution
+    + stale beacon), re-rendezvouses over the survivor set, restores the
+    flushed checkpoint onto the reduced mesh and finishes the run.
+    Reported: detection-to-resumed-training wall for the REAL process kill,
+    the post-recovery foreground-compile sentinel, and the survivor's
+    end-of-run fleet shape."""
+    import socket
+
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests", "_mh_worker.py"
+    )
+    if not os.path.exists(worker):
+        return {"error": "tests/_mh_worker.py not found"}
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    tmp = tempfile.mkdtemp(prefix="bench_mh_ab_")
+    hb = os.path.join(tmp, "hb")
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(
+        DBS_MH_RDZV="1",
+        DBS_PEER_HB_DIR=hb,
+        DBS_MH_CKPT=os.path.join(tmp, "ck"),
+        DBS_MH_EPOCHS=os.environ.get("BENCH_MH_AB_EPOCHS", "3"),
+        DBS_MH_WS="4",
+        DBS_PEER_HB_PERIOD_S="0.2",
+        DBS_PEER_HB_STALE_S="2.0",
+        DBS_RDZV_TIMEOUT_S="60",
+    )
+    timeout_s = float(os.environ.get("BENCH_MH_AB_TIMEOUT", 420))
+    logs = [os.path.join(tmp, f"p{i}.log") for i in range(2)]
+    procs = []
+    try:
+        for i in range(2):
+            with open(logs[i], "w") as lf:
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, worker, str(i), "2", str(port)],
+                        stdout=lf,
+                        stderr=subprocess.STDOUT,
+                        env=env,
+                        cwd=repo,
+                    )
+                )
+        marker = os.path.join(hb, "epoch1_p1.marker")
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and not os.path.exists(marker):
+            if any(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.1)
+        if not os.path.exists(marker):
+            return {"error": "fleet never reached epoch 1"}
+        procs[1].send_signal(signal.SIGKILL)
+        t_kill = time.time()
+        rc0 = procs[0].wait(timeout=timeout_s)
+        wall_after_kill = time.time() - t_kill
+        out0 = open(logs[0]).read()
+        if rc0 != 0:
+            return {
+                "error": f"survivor rc={rc0}",
+                "tail": out0[-500:],
+            }
+        lines = [ln for ln in out0.splitlines() if ln.startswith("RESULT ")]
+        if not lines:
+            return {"error": "survivor produced no RESULT line"}
+        r = json.loads(lines[-1][len("RESULT "):])
+        ev = next(
+            (e for e in r.get("elastic_events", []) if "lost" in e), None
+        )
+        if ev is None or r.get("n_proc") != 1:
+            return {
+                "error": "no shrink rendezvous recorded",
+                "events": r.get("elastic_events", []),
+            }
+        ab = {
+            "killed_proc": 1,
+            "detect_to_resume_s": ev["detect_to_resume_s"],
+            "rdzv_gen": ev["rdzv_gen"],
+            "restored_from": ev["restored_from"],
+            "world_size_after": r["world_size"],
+            "survivor_wall_after_kill_s": round(wall_after_kill, 2),
+            "post_recovery_fg_compiles": [
+                int(v) for v in r.get("xla_compiles", [])[ev["epoch"] + 1:]
+            ],
+            "losses_after_recovery": [
+                round(float(v), 6) for v in r.get("losses", [])[ev["epoch"]:]
+            ],
+        }
+        return ab
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=30)
+            except (OSError, ProcessLookupError, subprocess.TimeoutExpired):
+                pass
+        import shutil as _sh
+
+        _sh.rmtree(tmp, ignore_errors=True)
 
 
 def run_grad_comm_ab(out_path: str) -> int:

@@ -355,6 +355,12 @@ class RetFact:
     device_put_copied: bool  # every put arg is copy-wrapped
     line: int
     spec: Optional["SpecCtor"] = None  # return IS a spec construction
+    # Axis-tuple/string-literal RETURN (``return ("host", "device")`` /
+    # ``return HOST_AXIS``): the channel that lets graftmesh resolve
+    # ATTRIBUTE-valued collective-axis spellings through simple property
+    # returns (the G014 ``self._axis_arg`` residual gap, ISSUE 14). Same
+    # encoding as BindFact.rhs_axes; None for opaque returns.
+    axes: Optional[Tuple[Optional[str], ...]] = None
 
 
 @dataclass(frozen=True)
@@ -850,12 +856,18 @@ class _FunctionLowerer:
                 ]
                 put_of = tuple(srcs) or ("<expr>",)
                 put_copied = _is_copy_expr(v.args[0])
+        ret_axes: Optional[Tuple[Optional[str], ...]] = None
+        if isinstance(v, (ast.Tuple, ast.List)) or (
+            isinstance(v, ast.Constant) and isinstance(v.value, str)
+        ) or dotted_name(v) is not None:
+            ret_axes = _axes_tuple(v)
         return RetFact(
             alias_tokens=tuple(_alias_sources(v)),
             device_put_of=put_of,
             device_put_copied=put_copied,
             line=stmt.lineno,
             spec=spec_ctor(v) if isinstance(v, ast.Call) else None,
+            axes=ret_axes,
         )
 
     def _attr_accesses(

@@ -609,9 +609,18 @@ class MeshModel:
         """Concrete axis names each function's collectives consume, closed
         over the call graph (bottom-up union — the demand a shard_map's
         mesh must satisfy). Axis tokens resolve through module constants
-        and the function's own parameter defaults."""
+        and the function's own parameter defaults; ATTRIBUTE-valued tokens
+        (``self._axis_arg``) resolve through simple property returns
+        (literal axes, property chaining, or a live-mesh ``axis_names``
+        derivation — the last contributes no demand: axes OF an existing
+        mesh cannot be undefined), and the ones that stay opaque land in
+        :attr:`unresolved_axis_sites` for G014's explicit "unresolved axis
+        expression" diagnostic instead of erring quiet."""
         self.required_axes: Dict[str, Set[str]] = {}
         self.axis_sites: Dict[str, List[Tuple[str, int, int, str]]] = {}
+        # (fqn, line, col, collective tail, token): attribute-valued axis
+        # arguments no resolution channel could ground
+        self.unresolved_axis_sites: List[Tuple[str, int, int, str, str]] = []
         for fqn, fn in self.functions.items():
             req: Set[str] = set()
             sites: List[Tuple[str, int, int, str]] = []
@@ -664,11 +673,141 @@ class MeshModel:
             r = self.resolve_axis_entry(e, fn)
             if r is not None:
                 out.append(r)
-            elif e.startswith("$"):
-                out.extend(
-                    self._local_axis_tuple(fn, e[1:], call.line)
+                continue
+            if not e or not e.startswith("$"):
+                continue
+            tok = e[1:]
+            if "." not in tok:
+                out.extend(self._local_axis_tuple(fn, tok, call.line))
+                continue
+            # attribute-valued spelling (the recorded G014 residual gap):
+            # resolve through a simple property return, or record an
+            # explicit "unresolved axis expression" site — never silence
+            res = self._attr_axis_entries(fn, tok)
+            if res is None:
+                self.unresolved_axis_sites.append(
+                    (Project.fqn(fn), call.line, call.col, call.tail, tok)
                 )
+            else:
+                out.extend(a for a in res if a)
         return out
+
+    def _attr_axis_entries(
+        self, fn: FunctionSummary, tok: str, depth: int = 0
+    ) -> Optional[List[str]]:
+        """Resolve a ``self.<attr>`` collective-axis token through the
+        class's PROPERTY (or zero-arg method) of that name. Three outcomes:
+        a list of concrete axis names (literal-returning property — they
+        join the demand and the universe checks), an EMPTY list (the
+        property derives its value from a live mesh's own ``axis_names`` —
+        mesh_batch_axes-style — so whatever it names exists by
+        construction and there is no unmet demand), or None (opaque: the
+        caller records an unresolved-axis-expression site)."""
+        if depth > 3 or not fn.cls or not tok.startswith("self."):
+            return None
+        attr = tok.split(".", 1)[1]
+        if "." in attr:
+            return None
+        prop = self.functions.get(f"{fn.module}::{fn.cls}.{attr}")
+        if prop is None:
+            return None
+        edge_by_line = self.edges_by_line(Project.fqn(prop))
+        for stmt in prop.stmts:
+            if stmt.ret is not None:
+                ret = stmt.ret
+                # (a) literal / constant-resolvable axes return
+                axes = ret.axes or ()
+                resolved: List[str] = []
+                ok = bool(axes) and axes != ("?",)
+                for e in axes:
+                    if e == "?":
+                        ok = False
+                        break
+                    if e is None:
+                        continue
+                    r = self.resolve_axis_entry(e, prop)
+                    if r is None:
+                        ok = False
+                        break
+                    resolved.append(r)
+                if ok and resolved:
+                    return resolved
+                # (b) aliases: a live mesh's own axis names, a chained
+                # property, or a local bound to a literal axes tuple
+                for t in ret.alias_tokens:
+                    if t.endswith(".axis_names"):
+                        return []
+                    if (
+                        t.startswith("self.")
+                        and "." not in t.split(".", 1)[1]
+                        and t != tok
+                    ):
+                        got = self._attr_axis_entries(prop, t, depth + 1)
+                        if got is not None:
+                            return got
+                    if "." not in t:
+                        local = self._local_axis_tuple(prop, t, ret.line)
+                        if local:
+                            return local
+                # (c) a call into a helper whose value derives from a
+                # mesh's own axis_names (parallel/mesh.py mesh_batch_axes)
+                for call in stmt.calls:
+                    e2 = edge_by_line.get((call.tail, call.line))
+                    callee = (
+                        self.functions.get(e2.callee) if e2 is not None else None
+                    )
+                    if callee is not None and self._derives_from_axis_names(
+                        callee
+                    ):
+                        return []
+        # direct in-property derivation (``names = tuple(self.mesh.
+        # axis_names); return names[0] if ... else names``) — same
+        # consistency-by-construction argument as the helper form, but
+        # only when the RETURNED value actually connects to axis_names:
+        # an unrelated axis_names read elsewhere in the body must not
+        # silence an opaque return (the err-quiet gap this resolver
+        # closes)
+        if self._return_derives_from_axis_names(prop):
+            return []
+        return None
+
+    @staticmethod
+    def _return_derives_from_axis_names(fn: FunctionSummary) -> bool:
+        """Some return VALUE of ``fn`` is a function of a mesh's
+        ``axis_names``: the return aliases a local whose bind chain
+        reaches an ``axis_names`` read (one-direction taint over the
+        straight-line bind facts), or names ``axis_names`` directly."""
+        tainted: set = set()
+        for stmt in fn.stmts:
+            b = stmt.bind
+            if b is None:
+                continue
+            rhs = set(b.rhs_idents)
+            if "axis_names" in rhs or (tainted & rhs):
+                tainted.update(b.targets)
+        for stmt in fn.stmts:
+            ret = stmt.ret
+            if ret is None:
+                continue
+            for t in ret.alias_tokens:
+                if t.endswith(".axis_names"):
+                    return True
+                if t in tainted or t.split(".", 1)[0] in tainted:
+                    return True
+        return False
+
+    @staticmethod
+    def _derives_from_axis_names(fn: FunctionSummary) -> bool:
+        """The helper's value is a function of some mesh's ``axis_names``
+        (read anywhere in its body) — the mesh_batch_axes/zero1_chunk_axes
+        shape: whatever it returns names axes the mesh actually defines."""
+        for stmt in fn.stmts:
+            for t, _l, _c in stmt.reads:
+                if t.endswith(".axis_names"):
+                    return True
+            if stmt.bind is not None and "axis_names" in stmt.bind.rhs_idents:
+                return True
+        return False
 
     def _local_axis_tuple(
         self, fn: FunctionSummary, tok: str, at_line: int
@@ -807,8 +946,50 @@ class RuleG014:
     def check(self, ctx) -> Iterator["Finding"]:
         model = _get_model(ctx)
         yield from self._check_axis_universe(ctx, model)
+        yield from self._check_unresolved_axis_exprs(ctx, model)
         yield from self._check_shard_map(ctx, model)
         yield from self._check_elastic_sizes(ctx, model)
+
+    # -- (a') attribute-valued axis expressions that resolve to nothing ------
+
+    def _check_unresolved_axis_exprs(
+        self, ctx, model: MeshModel
+    ) -> Iterator["Finding"]:
+        """The closed G014 residual gap (ISSUE 14): an ATTRIBUTE-valued
+        collective-axis argument (``psum(x, self._axis_arg)``) that none of
+        the resolution channels could ground — not a literal-returning
+        property, not a module constant, not a live-mesh ``axis_names``
+        derivation — used to err quiet; now it is an explicit diagnostic,
+        because a collective whose axis the model cannot see is exactly
+        where a mesh refactor silently rebinds the reduction."""
+        seen: Set[Tuple[str, int, str]] = set()
+        for fqn, line, col, tail, tok in model.unresolved_axis_sites:
+            fn = ctx.project.functions.get(fqn)
+            if fn is None:
+                continue
+            path = ctx.path_of(fn)
+            if (path, line, tok) in seen:
+                continue
+            seen.add((path, line, tok))
+            if ctx.suppressed(fn, self.code, line):
+                continue
+            yield _finding(
+                self.code,
+                path,
+                line,
+                col,
+                f"`{tail}` takes the attribute-valued collective axis "
+                f"`{tok}` — an unresolved axis expression (no "
+                "literal-returning property, module constant, or live-mesh "
+                "axis_names derivation grounds it), so no axis-consistency "
+                "check can protect this collective across a mesh refactor",
+                "return a literal axis (or tuple) from the property, route "
+                "it through a module constant, or derive it from the live "
+                "mesh's own axis_names (mesh_batch_axes-style) so the value "
+                "is consistent by construction; sanction the site if the "
+                "expression is deliberately dynamic",
+                symbol=f"{fn.module}::{fn.qualname}",
+            )
 
     # -- (a) axis names no mesh defines -------------------------------------
 

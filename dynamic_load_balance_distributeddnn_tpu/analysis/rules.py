@@ -1378,16 +1378,19 @@ class RuleG009:
 class RuleG010:
     code = "G010"
     summary = (
-        "blocking device-side call in a retry/recovery scope without "
-        "heartbeat() coverage or a retry/timeout wrapper"
+        "blocking device-side or rendezvous call in a retry/recovery scope "
+        "without heartbeat()/tick() coverage or a retry/timeout wrapper"
     )
     fix_hint = (
-        "recovery scopes run exactly when the fleet is misbehaving — a "
-        "blocking PJRT call (block_until_ready/device_put/device_get/"
-        ".compile()) there can hang in C++ against a dead runtime, and "
-        "without a heartbeat() the stall watchdog reads the recovery itself "
-        "as the hang. Call heartbeat() after each blocking edge in the "
-        "scope, or wrap the edge in retry_transient(..., tick=heartbeat)"
+        "recovery and rendezvous scopes run exactly when the fleet is "
+        "misbehaving — a blocking PJRT call (block_until_ready/device_put/"
+        "device_get/.compile()) or coordination edge (jax.distributed "
+        "initialize/shutdown, client connect, barrier waits) there can hang "
+        "in C++ against a dead runtime or peer, and without a heartbeat() "
+        "the stall watchdog reads the recovery itself as the hang. Call "
+        "heartbeat() (or the state machine's tick()) after each blocking "
+        "edge in the scope, or wrap the edge in retry_transient(..., "
+        "tick=heartbeat) with a bounded timeout"
     )
 
     # The rule only makes sense where the elasticity machinery EXISTS:
@@ -1397,13 +1400,33 @@ class RuleG010:
     _GATE_NAMES = {"WorkerLost", "WorkerHealth", "retry_transient"}
     # Recovery scopes by naming convention (mirrors G009's dispatch-scope
     # convention): the engine's failure-detection -> drain -> re-solve ->
-    # re-shard -> readmit path.
-    _SCOPE_MARKERS = ("recover", "readmit", "reshard")
+    # re-shard -> readmit path, plus the multi-host RENDEZVOUS scopes
+    # (ISSUE 14) — propose/agree/barrier/establish run exactly while the
+    # fleet is broken, so an unarmored blocking edge there hangs the
+    # recovery itself.
+    _SCOPE_MARKERS = (
+        "recover",
+        "readmit",
+        "reshard",
+        "rendezvous",
+        "rdzv",
+        "establish",
+        "agree",
+        "elastic_initialize",
+        "retire",
+    )
     # Blocking device-side call tails.
     _BLOCKING_TAILS = {
         "block_until_ready",
         "device_put",
         "device_get",
+        # rendezvous-scope blocking edges: coordination-service bring-up /
+        # teardown and its barriers block on REMOTE processes — the peers a
+        # recovery exists to outlive
+        "initialize",
+        "shutdown",
+        "connect",
+        "wait_at_barrier",
     }
 
     def _module_gated(self, ctx) -> bool:
@@ -1447,11 +1470,13 @@ class RuleG010:
     @staticmethod
     def _covered(fn: ast.AST) -> bool:
         """heartbeat() anywhere in the scope keeps the watchdog fed across
-        its blocking edges."""
+        its blocking edges; ``tick()`` is the rendezvous state machine's
+        injected spelling of the same pulse (runtime/rendezvous.py wires
+        ``tick=heartbeat``)."""
         for n in ast.walk(fn):
             if isinstance(n, ast.Call):
                 tail = _attr_tail(call_name(n))
-                if tail == "heartbeat":
+                if tail in ("heartbeat", "tick"):
                     return True
         return False
 

@@ -538,12 +538,6 @@ class Config:
                 "already rides --grad_comm_wire (the flat int8 collective "
                 "stays available via compress_grads with grad_comm=flat)"
             )
-        if self.grad_comm == "hier" and self.elastic == "on":
-            raise ValueError(
-                "grad_comm=hier's two-level mesh cannot survive an elastic "
-                "re-shard yet (the survivor fleet may not re-factor into "
-                "equal host groups); run elastic fleets on the flat combine"
-            )
         if self.grad_comm == "hier" and self.seq_parallel:
             raise ValueError(
                 "grad_comm=hier applies to the data-parallel gradient "

@@ -333,6 +333,7 @@ class PreemptionInjector(FaultInjector):
         self.logger = logger
         self._rolled_epochs: Set[int] = set()
         self._pids: Dict[int, int] = {}
+        self._respawns: Dict[int, object] = {}
         self._delivered: Set[tuple] = set()
 
     # ------------------------------------------------------------- schedule
@@ -411,6 +412,17 @@ class PreemptionInjector(FaultInjector):
         """Bind a worker to a live OS process for real signal delivery."""
         self._pids[int(worker)] = int(pid)
 
+    def attach_respawn(self, worker: int, spawn) -> None:
+        """Bind a worker to a respawn callable (ISSUE 14): at a "kill"
+        event's ``rejoin_epoch`` edge, :meth:`deliver` calls ``spawn()``
+        once — the chaos-harness hook that turns a SIGKILLed process into a
+        kill → shrink → rejoin → grow round-trip (the respawned process
+        offers a rendezvous join; the survivors admit it at their next
+        epoch boundary). ``spawn`` may return the new pid (or a Popen with
+        a ``pid``), in which case the worker is re-attached for any later
+        scheduled signals; idempotent per edge like every other delivery."""
+        self._respawns[int(worker)] = spawn
+
     def deliver(self, t: float) -> List[tuple]:
         """Send every signal due by epoch-time ``t`` to attached processes
         (each edge delivered once): SIGKILL for "kill", SIGSTOP at a
@@ -446,6 +458,23 @@ class PreemptionInjector(FaultInjector):
                         sent.append((ev.worker, "SIGCONT"))
                     except ProcessLookupError:
                         pass
+            if (
+                ev.kind == "kill"
+                and ev.rejoin_epoch is not None
+                and ev.rejoin_epoch <= t
+                and ev.worker in self._respawns
+            ):
+                # a SIGKILLed PROCESS cannot SIGCONT back — its rejoin edge
+                # is a RESPAWN (the spawned process offers a rendezvous
+                # join and the fleet re-grows at the next epoch boundary)
+                key = (ev.worker, ev.rejoin_epoch, "respawn")
+                if key not in self._delivered:
+                    self._delivered.add(key)
+                    got = self._respawns[ev.worker]()
+                    new_pid = getattr(got, "pid", got)
+                    if isinstance(new_pid, int):
+                        self._pids[ev.worker] = new_pid
+                    sent.append((ev.worker, "RESPAWN"))
         return sent
 
 

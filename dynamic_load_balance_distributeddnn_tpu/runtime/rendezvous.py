@@ -1,0 +1,864 @@
+"""Epoch-boundary re-rendezvous over survivors (ISSUE 14).
+
+PR 6 made in-process worker loss survivable; the *cross-process* story was
+"detect via heartbeat files, diagnose via exit tags, abort and resume from
+checkpoint" — jax cannot shrink a live multi-host mesh. This module closes
+that gap: when a peer PROCESS is confirmed gone, the survivors reach
+consensus on the survivor roster through the heartbeat-file directory
+(propose -> agree), tear down ``jax.distributed``, and re-initialize a
+smaller world over a fresh coordinator port (barrier -> establish). The
+engine then rebuilds topology/mesh/StepLibrary over the survivor fleet and
+resumes from its epoch-start snapshot.
+
+Mechanism notes — every line of this was established empirically against
+jax 0.4.37 / its bundled XLA coordination service, because the obvious
+routes are all fatal:
+
+* The coordination service hard-aborts survivors (``LOG(QFATAL)`` in
+  pjrt/distributed/client.h) the moment a peer is declared unhealthy or the
+  coordinator socket closes. The pybind ``missed_heartbeat_callback`` that
+  would make this non-fatal cannot be used: this jaxlib's
+  ``absl::Status -> Python`` caster throws ``std::bad_cast`` (-> terminate)
+  before any Python callback runs.
+* Therefore coordination-service HEARTBEATS ARE DISABLED (interval pushed to
+  a day) — peer liveness is the file-beacon layer's job
+  (:class:`runtime.health.ProcessHeartbeat`), which is faster anyway
+  (seconds, not the service's 100s default window).
+* A client whose peer died can never be shut down cleanly: ``shutdown()``
+  runs a barrier the dead peer will not answer, and the barrier failure is
+  routed to the fatal error poller. Dropping Python references does not
+  help — the C++ error-polling thread pins the object. Retired clients and
+  services are therefore DELIBERATELY LEAKED (:data:`_RETIRED`): a few
+  threads + buffers per fleet generation, bounded by the recovery budget.
+  Their pollers only watch the generation-0 coordinator process, so they
+  stay silent until that process exits.
+* Consequence: COORDINATOR-PROCESS DEATH IS NOT SURVIVABLE — the poll RPC
+  errors instantly on its closed socket and every survivor aborts. That is
+  the documented remaining non-goal (README "Fault tolerance"), handled by
+  the watchdog/abort-and-resume ladder like before this PR.
+* ``xla_bridge``'s module-level ``@lru_cache``\\ s (``process_count`` et al.)
+  survive ``_clear_backends`` and must be cleared explicitly, or the new
+  world inherits the old world's process count.
+
+Every blocking phase is armored: bounded timeouts raise
+:class:`RendezvousTimeout` tagged with the phase that died (the engine falls
+back to today's abort-and-resume and logs it), and the wait loops tick the
+stall watchdog so a slow rendezvous never reads as a device hang.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gc
+import glob
+import json
+import os
+import re
+import socket
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
+from dynamic_load_balance_distributeddnn_tpu.runtime.health import retry_transient
+from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import heartbeat
+
+# Coordination-service heartbeats OFF (see module docstring): liveness is
+# the file-beacon layer's job, and an enabled service window would abort
+# the survivors it notices a death before we finish re-rendezvousing.
+_HB_DISABLED = dict(heartbeat_interval=86400, max_missing_heartbeats=1000)
+_SHUTDOWN_TIMEOUT_S = 10
+
+# Deliberately leaked retired runtime objects (clients/services of previous
+# fleet generations) — see the module docstring for why they cannot be
+# destroyed. Bounded: one client (+ one service on the coordinator) per
+# recovery, and recoveries are budgeted (cfg.elastic_max_recoveries).
+_RETIRED: List[object] = []
+
+_POLL_S = 0.05
+_TICK_EVERY_S = 1.0
+
+
+def _env_timeout(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def rendezvous_timeout_s() -> float:
+    """Per-phase rendezvous timeout (env ``DBS_RDZV_TIMEOUT_S``)."""
+    return _env_timeout("DBS_RDZV_TIMEOUT_S", 120.0)
+
+
+class RendezvousError(RuntimeError):
+    """Rendezvous failed; ``phase`` names the phase that died. The engine
+    degrades to the abort-and-resume ladder instead of hanging."""
+
+    def __init__(self, phase: str, message: str = ""):
+        self.phase = phase
+        super().__init__(message or f"rendezvous failed in phase '{phase}'")
+
+
+class RendezvousTimeout(RendezvousError):
+    """A blocking rendezvous phase exceeded its hard timeout."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Agreement:
+    """The consensus a survivor set reached: who survives, which generation
+    this is, where the new coordinator listens, and which epoch training
+    resumes at."""
+
+    gen: int
+    roster: Tuple[int, ...]  # ORIGINAL process ids, sorted
+    rank: int                # my process id in the NEW world
+    address: str
+    epoch: int
+
+    @property
+    def leader(self) -> bool:
+        return self.rank == 0
+
+
+def _write_json(path: str, obj: Dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)  # atomic: readers never see a partial file
+
+
+def _read_json(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _pick_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _global_state():
+    from jax._src import distributed
+
+    return distributed.global_state
+
+
+def _xla_extension():
+    from jax._src.lib import xla_extension
+
+    return xla_extension
+
+
+def _make_service(address: str, num_processes: int):
+    bind = "[::]:" + address.rsplit(":", 1)[1]
+    return _xla_extension().get_distributed_runtime_service(
+        bind, num_processes,
+        shutdown_timeout=_SHUTDOWN_TIMEOUT_S, **_HB_DISABLED,
+    )
+
+
+def _make_client(address: str, process_id: int, timeout_s: float):
+    return _xla_extension().get_distributed_runtime_client(
+        address, process_id,
+        init_timeout=max(int(timeout_s), 1),
+        shutdown_timeout=_SHUTDOWN_TIMEOUT_S,
+        # dtor must never run the shutdown barrier: a dead peer turns it
+        # into a fatal error (module docstring)
+        shutdown_on_destruction=False,
+        use_compression=True,
+        **_HB_DISABLED,
+    )
+
+
+def _arm_preemption_sync(gs, client) -> None:
+    # orbax's multihost save path gates every step on the preemption sync
+    # point; the stock initializer arms this, so the elastic bring-up must
+    # too (it rides the coordination client, NOT the disabled heartbeats)
+    mgr = _xla_extension().create_preemption_sync_manager()
+    mgr.initialize(client)
+    gs.preemption_sync_manager = mgr
+
+
+def elastic_initialize(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    rdzv_dir: Optional[str] = None,
+    timeout_s: Optional[float] = None,
+) -> None:
+    """Generation-0 ``jax.distributed`` bring-up for an ELASTIC multi-host
+    run — same contract as ``jax.distributed.initialize`` but with the
+    coordination service configured so peer-process death is survivable
+    (heartbeats disabled, no shutdown-on-destruction; see module
+    docstring). Workers that may need to re-rendezvous must start through
+    here: a world built by the stock initializer aborts all survivors the
+    moment any peer dies."""
+    timeout_s = rendezvous_timeout_s() if timeout_s is None else timeout_s
+    gs = _global_state()
+    if gs.client is not None:
+        raise RuntimeError("distributed runtime already initialized")
+    # fields first: the lazily built CPU backend reads them (node id /
+    # world size) the moment anything touches jax.devices()
+    gs.process_id = process_id
+    gs.num_processes = num_processes
+    gs.coordinator_address = coordinator_address
+    if process_id == 0:
+        gs.service = _make_service(coordinator_address, num_processes)
+        if rdzv_dir:
+            os.makedirs(rdzv_dir, exist_ok=True)
+            # a REUSED directory (abort-and-resume restarts the fleet in
+            # the same DBS_PEER_HB_DIR) still holds the dead run's protocol
+            # files: the newest stale ack would win current_roster()'s
+            # generation adoption, and that generation's loss claims would
+            # mark freshly restarted peers down at the first boundary.
+            # Clear them BEFORE publishing ack_g0 — peers connect (and
+            # first read the directory) only after this process's service
+            # is up, so the wipe cannot race a live writer.
+            reset_rendezvous_dir(rdzv_dir)
+            _write_json(
+                os.path.join(rdzv_dir, "ack_g0.json"),
+                {
+                    "address": coordinator_address,
+                    "roster": list(range(num_processes)),
+                    "epoch": 0,
+                    "payload": {},
+                },
+            )
+    client = _make_client(coordinator_address, process_id, timeout_s)
+    retry_transient(
+        client.connect, retries=2, desc="gen-0 distributed connect",
+        tick=heartbeat,
+    )
+    gs.client = client
+    _arm_preemption_sync(gs, client)
+    heartbeat()
+
+
+def local_canary_launch() -> None:
+    """One sacrificial multi-device launch over this process's LOCAL
+    devices, blocked to completion. Shared by the drain/quarantine/rebuild
+    canaries: it serializes behind the process-local collective-launch
+    chain (a wedged or inherited dispatch surfaces HERE, not in the next
+    stage's launches), and it deliberately touches no peer — in a
+    multi-process world a device_put to a sharding spanning other
+    processes runs a hidden gloo broadcast that an asymmetric recovery
+    would mispair. The fresh put + compile each call is the mechanism, not
+    a leak (graftlint G001/G006 sanctioned here, once)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = sorted(jax.local_devices(), key=lambda d: d.id)
+    mesh = Mesh(np.array(devs), ("canary",))
+    x = jax.device_put(  # graftlint: disable=G006
+        np.ones((max(len(devs), 1),), np.float32),
+        NamedSharding(mesh, PartitionSpec()),
+    )
+    jax.block_until_ready(jax.jit(lambda a: a + 1.0)(x))  # graftlint: disable=G001
+
+
+def reset_rendezvous_dir(rdzv_dir: str) -> int:
+    """Remove a PREVIOUS run's rendezvous protocol files from a reused
+    directory (acks, loss claims, proposals, teardown/exit barriers, join
+    offers) so a fresh generation-0 bring-up cannot adopt a dead run's
+    generation or its loss verdicts. Beacon/marker files are left alone —
+    live processes overwrite their own beacons at arm time. Returns the
+    number of files removed. Only the gen-0 COORDINATOR may call this, and
+    only before publishing ``ack_g0`` (peers first read the directory
+    after connecting to its service)."""
+    removed = 0
+    for pat in (
+        "ack_g*.json",
+        "loss_g*.json",
+        "propose_g*.json",
+        "torn_g*",
+        "done_p*",
+        "join_p*.json",
+    ):
+        for path in glob.glob(os.path.join(rdzv_dir, pat)):
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+def drain_collective_chain(
+    timeout_s: Optional[float] = None, logger=None, tick: Callable = heartbeat
+) -> bool:
+    """Force the CURRENT (about-to-be-retired) world's wedged in-flight
+    collectives to resolve before the next world is built. Returns True if
+    the chain drained inside the budget.
+
+    Mechanism: XLA:CPU serializes every multi-device launch behind the
+    last collective-launch event, and collective participants meet in a
+    PROCESS-GLOBAL refcounted rendezvous map. A peer dying mid-collective
+    leaves launches wedged on half-dead gloo ops; until they resolve
+    (socket teardown — async, seconds), their entries poison launches of
+    the NEXT world through that global map. A sacrificial LOCAL-devices
+    launch dispatched here serializes behind every wedged launch, so
+    blocking on it (in a side thread, bounded — gloo's own timeout can be
+    minutes) ensures the chain has fully resolved; its error, if any, is
+    the dead world's and is swallowed. A chain that outlives the budget is
+    left to the post-establish quarantine/rebuild retries."""
+    import threading
+
+    if timeout_s is None:
+        timeout_s = _env_timeout("DBS_RDZV_DRAIN_S", 12.0)
+    done = threading.Event()
+
+    def _drain() -> None:
+        try:
+            local_canary_launch()
+        except Exception:  # noqa: BLE001 — the dead world's error, expected
+            pass
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_drain, daemon=True, name="rdzv-drain")
+    t.start()
+    deadline = time.monotonic() + timeout_s
+    while not done.is_set():
+        if time.monotonic() >= deadline:
+            if logger is not None:
+                logger.warning(
+                    f"rendezvous: old collective chain did not drain in "
+                    f"{timeout_s:.0f}s — proceeding (quarantine retries "
+                    "cover a late resolution)"
+                )
+            return False
+        tick()
+        done.wait(0.25)
+    return True
+
+
+def reset_backend() -> None:
+    """Tear down the process's XLA backends and every jax-level cache that
+    pins them or their world shape. Safe to call repeatedly; the next jax
+    device access rebuilds against the CURRENT ``global_state`` fields."""
+    import jax
+    import jax._src.xla_bridge as xb
+
+    jax.clear_caches()
+    # EVERY module-level @lru_cache accessor survives _clear_backends and
+    # must be cleared by hand — sweep dynamically rather than naming them:
+    # missing even one (jax 0.4.36 caches ``local_devices``!) silently
+    # hands the RETIRED client's devices to the next world, and every
+    # launch built on them chains behind the dead world's poisoned
+    # dispatch events
+    for name in dir(xb):
+        fn = getattr(xb, name, None)
+        if callable(fn) and hasattr(fn, "cache_clear"):
+            fn.cache_clear()
+    xb._clear_backends()
+    gc.collect()
+
+
+def _reset_orbax_barrier_counters() -> None:
+    """Zero orbax's process-local barrier-key counters on EVERY member of a
+    freshly established world. Orbax bakes ``itertools.count()`` ordinals
+    into its cross-process barrier keys (``AsyncCheckpointer.__init__``
+    takes one per construction, saves take one per operation) — a survivor
+    that has built managers in earlier generations carries a higher count
+    than a just-spawned joiner, so their keys for the SAME logical barrier
+    hash differently and the sync fails (observed:
+    ``'0_Checkpointer:restore.2'`` vs the survivor's ``'2_…'``). After this
+    reset both sides perform identical checkpoint-operation sequences, so
+    the counters advance in lockstep."""
+    try:
+        import itertools
+
+        from orbax.checkpoint.multihost import counters
+    except Exception:  # noqa: BLE001 — orbax optional / layout drift
+        return
+    for name in dir(counters):
+        if name.startswith("_") and name.endswith("_counter"):
+            try:
+                setattr(counters, name, itertools.count())
+            except Exception:  # noqa: BLE001 — never block a rendezvous on this
+                pass
+
+
+def retire_runtime() -> None:
+    """Leak the current distributed client (and service, on the
+    coordinator) into :data:`_RETIRED` and purge every jax-level cache that
+    pins the old backend or its world shape. After this the process holds
+    no usable jax runtime until :meth:`RendezvousStateMachine.establish`
+    builds the next one — callers must have snapshotted any device state
+    to host first."""
+    gs = _global_state()
+    if gs.client is not None:
+        _RETIRED.append(gs.client)
+        gs.client = None
+    if gs.service is not None:
+        # the old service must OUTLIVE the old clients' error pollers
+        # (they poll this process's socket); leaked alongside them
+        _RETIRED.append(gs.service)
+        gs.service = None
+    if gs.preemption_sync_manager is not None:
+        # rides the retired client's channel; leaked alongside it
+        _RETIRED.append(gs.preemption_sync_manager)
+        gs.preemption_sync_manager = None
+    reset_backend()
+    heartbeat()
+
+
+def retired_count() -> int:
+    """How many runtime objects previous generations leaked (observability
+    + tests)."""
+    return len(_RETIRED)
+
+
+def quarantine_runtime(logger=None, tick: Callable = heartbeat) -> int:
+    """Verify the re-initialized world's XLA backend dispatches multi-device
+    work cleanly, rebuilding it until it does. Returns the number of extra
+    rebuilds that were needed.
+
+    Why this exists (empirical, jax 0.4.36 XLA:CPU): a peer dying MID-
+    COLLECTIVE leaves the old client's collective-launch serialization chain
+    wedged on the half-dead gloo op. The first backend built after
+    ``retire_runtime`` can inherit that chain — its very first multi-device
+    dispatch then fails with the dead world's error (``Error dispatching
+    computation: … Gloo all-reduce failed``) and every later dispatch chains
+    one layer deeper, poisoning the new world permanently. A canary dispatch
+    detects the inheritance up front, and a fresh clear+rebuild once the old
+    chain has resolved comes up clean (observed reliably within a rebuild or
+    two). Armored like every other phase: bounded attempts, watchdog ticks,
+    and a :class:`RendezvousError` (-> abort-and-resume) when the runtime
+    never settles.
+
+    Recorded limitation: with MULTIPLE surviving processes a canary-driven
+    rebuild re-runs the CPU topology exchange against the generation's KV
+    store; survivors disagree-ing on their rebuild count is not handled
+    (the CPU-tier shrink target is a single surviving process)."""
+    gs = _global_state()
+    attempts = 4 if gs.num_processes in (None, 1) else 2
+    last: Optional[Exception] = None
+    for i in range(attempts):
+        tick()
+        try:
+            local_canary_launch()
+            if i and logger is not None:
+                logger.info(
+                    f"rendezvous: runtime quarantine settled after {i} "
+                    "extra rebuild(s)"
+                )
+            return i
+        except Exception as e:  # noqa: BLE001 — inherited-chain canary
+            last = e
+            if logger is not None:
+                logger.warning(
+                    f"rendezvous: rebuilt runtime inherited the dead "
+                    f"world's dispatch chain (attempt {i + 1}/{attempts}): "
+                    f"{str(e)[:200]}"
+                )
+            reset_backend()
+            time.sleep(0.5 * (i + 1))
+    raise RendezvousError(
+        "quarantine",
+        f"rebuilt runtime never dispatched cleanly: {last!r}",
+    )
+
+
+class RendezvousStateMachine:
+    """File-based propose -> agree -> barrier -> establish consensus over
+    the heartbeat directory.
+
+    One instance per process, identified by its ORIGINAL process id (the
+    ident its heartbeat beacon file carries). All files live in
+    ``rdzv_dir`` = the peer-heartbeat directory, so the failure detector
+    and the recovery protocol share one channel:
+
+    * ``propose_g{gen}_r{round}_p{id}.json`` — a survivor's roster view
+      (+ the would-be leader's port pick and resume epoch);
+    * ``torn_g{gen}_p{id}`` — "my old client is destroyed" (the barrier
+      that orders every client teardown before the new service exists);
+    * ``ack_g{gen}.json`` — the leader's "service is up" (address + an
+      opaque payload, e.g. the deterministically seeded controller
+      vectors every process must adopt);
+    * ``join_p{id}.json`` — a (re)spawned process offering to join at the
+      next epoch boundary;
+    * ``loss_g{gen}_p{id}.json`` — a survivor's published loss verdict, so
+      detection is coherent across survivors whose beacon scans lag;
+    * ``done_p{id}`` — clean-exit ordering (the coordinator process exits
+      last: retired clients' error pollers watch its sockets).
+    """
+
+    def __init__(
+        self,
+        rdzv_dir: str,
+        ident: int,
+        gen: int = 0,
+        logger=None,
+        tick: Callable = heartbeat,
+    ):
+        self.rdzv_dir = rdzv_dir
+        self.ident = int(ident)
+        self.gen = int(gen)
+        self.logger = logger
+        self.tick = tick
+        os.makedirs(rdzv_dir, exist_ok=True)
+
+    # ------------------------------------------------------------- scanning
+
+    def alive_procs(self, stale_s: Optional[float] = None) -> Set[int]:
+        """Process ids with a fresh beacon and no watchdog exit tag (self
+        included — its own beacon thread keeps it fresh)."""
+        from dynamic_load_balance_distributeddnn_tpu.runtime.health import (
+            ProcessHeartbeat,
+        )
+
+        if stale_s is None:
+            stale_s = _env_timeout("DBS_PEER_HB_STALE_S", 10.0)
+        out: Set[int] = set()
+        for ident, info in ProcessHeartbeat.scan(self.rdzv_dir).items():
+            m = re.fullmatch(r"proc(\d+)", ident)
+            if m and not ProcessHeartbeat.is_stale(info, stale_s):
+                out.add(int(m.group(1)))
+        out.add(self.ident)
+        return out
+
+    def offer_join(self) -> None:
+        """(Re)spawned process: offer to join at the next epoch boundary.
+        Idempotent; survivors pick it up via :meth:`pending_joins`."""
+        _write_json(
+            os.path.join(self.rdzv_dir, f"join_p{self.ident}.json"),
+            {"ident": self.ident},
+        )
+
+    def pending_joins(self) -> Set[int]:
+        out: Set[int] = set()
+        for path in glob.glob(os.path.join(self.rdzv_dir, "join_p*.json")):
+            info = _read_json(path)
+            if info is not None:
+                out.add(int(info["ident"]))
+        return out
+
+    def clear_join(self, ident: Optional[int] = None) -> None:
+        ident = self.ident if ident is None else int(ident)
+        try:
+            os.remove(os.path.join(self.rdzv_dir, f"join_p{ident}.json"))
+        except OSError:
+            pass
+
+    def current_roster(self) -> List[int]:
+        """ORIGINAL process ids of the newest ESTABLISHED generation (the
+        newest ack file), adopting that generation as :attr:`gen`. Empty
+        when no ack exists — a world brought up by the stock initializer
+        writes none; callers fall back to ``range(num_processes)``."""
+        best: Optional[Dict] = None
+        best_gen = -1
+        for path in glob.glob(os.path.join(self.rdzv_dir, "ack_g*.json")):
+            m = re.search(r"ack_g(\d+)\.json$", path)
+            if not m or int(m.group(1)) <= best_gen:
+                continue
+            info = _read_json(path)
+            if info is not None:
+                best, best_gen = info, int(m.group(1))
+        if best is None:
+            return []
+        self.gen = max(self.gen, best_gen)
+        return [int(p) for p in best.get("roster", ())]
+
+    # ----------------------------------------------------- loss coherence
+
+    def claim_loss(self, dead: Iterable[int], epoch: int) -> None:
+        """Publish this survivor's loss verdict so peers whose beacon scan
+        lags adopt it at their next boundary instead of dispatching one
+        more collective against the dead process."""
+        _write_json(
+            os.path.join(self.rdzv_dir, f"loss_g{self.gen}_p{self.ident}.json"),
+            {"dead": sorted(int(d) for d in dead), "epoch": int(epoch)},
+        )
+
+    def claimed_losses(self) -> Set[int]:
+        """Union of every survivor's published loss verdict for the CURRENT
+        generation (older generations' claims are resolved history)."""
+        out: Set[int] = set()
+        pat = os.path.join(self.rdzv_dir, f"loss_g{self.gen}_p*.json")
+        for path in glob.glob(pat):
+            info = _read_json(path)
+            if info is not None:
+                out.update(int(d) for d in info.get("dead", ()))
+        return out
+
+    # ----------------------------------------------------------- consensus
+
+    def _disk_gen(self) -> int:
+        gens = [0]
+        for path in glob.glob(os.path.join(self.rdzv_dir, "ack_g*.json")):
+            m = re.search(r"ack_g(\d+)\.json$", path)
+            if m:
+                gens.append(int(m.group(1)))
+        return max(gens)
+
+    def _wait(
+        self, cond: Callable[[], bool], timeout_s: float, phase: str
+    ) -> None:
+        """Poll ``cond`` until true; tick the stall watchdog about once a
+        second so the wait never reads as a device hang; hard-timeout into
+        :class:`RendezvousTimeout` tagged with the phase."""
+        deadline = time.monotonic() + timeout_s
+        last_tick = 0.0
+        while not cond():
+            now = time.monotonic()
+            if now >= deadline:
+                raise RendezvousTimeout(phase)
+            if now - last_tick >= _TICK_EVERY_S:
+                last_tick = now
+                self.tick()
+            time.sleep(_POLL_S)
+
+    def agree(
+        self,
+        alive_fn: Callable[[], Set[int]],
+        epoch: int,
+        timeout_s: Optional[float] = None,
+    ) -> Agreement:
+        """Roster consensus for the next generation: every member of the
+        agreed roster posted an identical roster view. Divergent views
+        (peers dying DURING the rendezvous, joiners racing in) converge by
+        intersecting the posted views with the live beacon scan and
+        advancing to a new proposal round; bounded rounds + a hard
+        timeout, so a wedged peer degrades the rendezvous instead of
+        hanging it."""
+        timeout_s = rendezvous_timeout_s() if timeout_s is None else timeout_s
+        tracer = get_tracer()
+        with tracer.span("rdzv_agree", cat="recover"):
+            gen = max(self.gen, self._disk_gen()) + 1
+            my_port = _pick_port()
+            deadline = time.monotonic() + timeout_s
+            roster = sorted(alive_fn())
+            for rnd in range(8):
+                if self.ident not in roster or not roster:
+                    raise RendezvousError(
+                        "propose", f"evicted from roster {roster}"
+                    )
+                _write_json(
+                    os.path.join(
+                        self.rdzv_dir,
+                        f"propose_g{gen}_r{rnd}_p{self.ident}.json",
+                    ),
+                    {"roster": roster, "port": my_port, "epoch": int(epoch)},
+                )
+                views: Dict[int, Dict] = {}
+                advance = False
+                last_tick = 0.0
+                while True:
+                    now = time.monotonic()
+                    if now >= deadline:
+                        missing = [p for p in roster if p not in views]
+                        raise RendezvousTimeout(
+                            f"propose[r{rnd}] waiting for proc(s) {missing}"
+                        )
+                    if now - last_tick >= _TICK_EVERY_S:
+                        last_tick = now
+                        self.tick()
+                    for p in roster:
+                        if p in views:
+                            continue
+                        got = _read_json(
+                            os.path.join(
+                                self.rdzv_dir,
+                                f"propose_g{gen}_r{rnd}_p{p}.json",
+                            )
+                        )
+                        if got is not None:
+                            views[p] = got
+                    if len(views) == len(roster):
+                        rosters = {tuple(v["roster"]) for v in views.values()}
+                        if len(rosters) == 1 and next(iter(rosters)) == tuple(
+                            roster
+                        ):
+                            leader = roster[0]
+                            agreed_epoch = max(
+                                int(v["epoch"]) for v in views.values()
+                            )
+                            port = int(views[leader]["port"])
+                            self.log(
+                                f"rendezvous g{gen}: roster {roster} agreed "
+                                f"(round {rnd}, leader proc{leader}, "
+                                f"port {port}, epoch {agreed_epoch})"
+                            )
+                            return Agreement(
+                                gen=gen,
+                                roster=tuple(roster),
+                                rank=roster.index(self.ident),
+                                address=f"localhost:{port}",
+                                epoch=agreed_epoch,
+                            )
+                        advance = True
+                    else:
+                        # a peer we wait on may have died mid-rendezvous:
+                        # refresh the live view and re-round without it
+                        live = alive_fn()
+                        if sorted(set(roster) & live) != roster:
+                            advance = True
+                    if advance:
+                        merged: Set[int] = set(roster)
+                        for v in views.values():
+                            merged &= set(v["roster"])
+                        merged &= alive_fn()
+                        merged.add(self.ident)
+                        roster = sorted(merged)
+                        break
+                    time.sleep(_POLL_S)
+            raise RendezvousError("propose", "no roster consensus in 8 rounds")
+
+    def establish(
+        self,
+        agreement: Agreement,
+        payload: Optional[Dict] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Dict:
+        """Bring up the agreed world: barrier on every member's client
+        teardown (``torn`` files — the old clients' error pollers must all
+        be gone before any new-world traffic), leader starts the new
+        coordination service and publishes the ack (+ ``payload``, the
+        replicated-controller seed every process adopts), everyone
+        connects. The caller must have called :func:`retire_runtime` (or
+        never held a runtime: joiners). Returns the ack payload."""
+        timeout_s = rendezvous_timeout_s() if timeout_s is None else timeout_s
+        tracer = get_tracer()
+        with tracer.span("rdzv_establish", cat="recover"):
+            gen, roster = agreement.gen, list(agreement.roster)
+            gs = _global_state()
+            if gs.client is not None:
+                raise RuntimeError(
+                    "establish() with a live distributed client — call "
+                    "retire_runtime() first"
+                )
+            open(
+                os.path.join(self.rdzv_dir, f"torn_g{gen}_p{self.ident}"), "w"
+            ).close()
+            self._wait(
+                lambda: all(
+                    os.path.exists(
+                        os.path.join(self.rdzv_dir, f"torn_g{gen}_p{p}")
+                    )
+                    for p in roster
+                ),
+                timeout_s,
+                f"teardown barrier g{gen}",
+            )
+            gs.process_id = agreement.rank
+            gs.num_processes = len(roster)
+            gs.coordinator_address = agreement.address
+            ack_path = os.path.join(self.rdzv_dir, f"ack_g{gen}.json")
+            if agreement.leader:
+                gs.service = retry_transient(
+                    lambda: _make_service(agreement.address, len(roster)),
+                    retries=2,
+                    desc="rendezvous service bring-up",
+                    tick=self.tick,
+                )
+                _write_json(
+                    ack_path,
+                    {
+                        "address": agreement.address,
+                        "roster": roster,
+                        "epoch": agreement.epoch,
+                        "payload": payload or {},
+                    },
+                )
+                ack = _read_json(ack_path)
+            else:
+                self._wait(
+                    lambda: _read_json(ack_path) is not None,
+                    timeout_s,
+                    f"service ack g{gen}",
+                )
+                ack = _read_json(ack_path)
+            client = _make_client(
+                agreement.address, agreement.rank, timeout_s
+            )
+            try:
+                retry_transient(
+                    client.connect,
+                    retries=1,
+                    desc=f"rendezvous g{gen} connect",
+                    tick=self.tick,
+                )
+            except Exception as e:  # noqa: BLE001 — degrade, don't hang
+                raise RendezvousError(
+                    f"connect g{gen}", f"connect to {agreement.address}: {e!r}"
+                )
+            gs.client = client
+            _arm_preemption_sync(gs, client)
+            _reset_orbax_barrier_counters()
+            self.gen = gen
+            self.tick()
+            self.log(
+                f"rendezvous g{gen}: world established over {roster} "
+                f"(rank {agreement.rank}/{len(roster)} at {agreement.address})"
+            )
+            return dict(ack.get("payload") or {}) if ack else {}
+
+    # -------------------------------------------------------- exit protocol
+
+    def finalize(self, timeout_s: float = 30.0) -> None:
+        """Clean-exit ordering: every process drops a ``done`` file; the
+        generation-0 COORDINATOR process (ident 0 — retired clients' error
+        pollers point at its sockets) waits for every still-live peer's
+        done file plus a short grace before returning, so it is the last
+        to exit and no peer's poller ever sees its sockets close."""
+        open(os.path.join(self.rdzv_dir, f"done_p{self.ident}"), "w").close()
+        if self.ident != 0:
+            return
+        peers = self.alive_procs() - {self.ident}
+        try:
+            self._wait(
+                lambda: all(
+                    os.path.exists(
+                        os.path.join(self.rdzv_dir, f"done_p{p}")
+                    )
+                    for p in self.alive_procs() - {self.ident}
+                ),
+                timeout_s,
+                "exit drain",
+            )
+        except RendezvousTimeout:
+            self.log(f"exit drain timed out waiting for {sorted(peers)}")
+        time.sleep(0.5)  # grace: peers' interpreters finish exiting
+
+    def log(self, msg: str) -> None:
+        if self.logger is not None:
+            self.logger.info(msg)
+
+
+def join_elastic_world(
+    rdzv_dir: str,
+    ident: int,
+    timeout_s: Optional[float] = None,
+    logger=None,
+    tick: Callable = heartbeat,
+) -> Tuple[RendezvousStateMachine, Agreement, Dict]:
+    """A (re)spawned process joins the running fleet at the survivors' next
+    epoch boundary: beacon first (the survivors' boundary scan must see a
+    FRESH pulse or the roster intersection evicts us), offer the join, then
+    enter the same propose → agree → barrier protocol the survivors run —
+    their boundary-side :meth:`pending_joins` check is what starts the
+    round, so the join timeout must cover at least one of their epochs
+    (``DBS_RDZV_JOIN_TIMEOUT_S``, default 600s). The caller must NOT have a
+    live ``jax.distributed`` runtime yet; after this returns, jax sees the
+    grown world and the caller builds its engine over it (restoring
+    training state from the shared checkpoint directory). Returns
+    ``(state_machine, agreement, ack payload)``."""
+    if timeout_s is None:
+        timeout_s = _env_timeout("DBS_RDZV_JOIN_TIMEOUT_S", 600.0)
+    sm = RendezvousStateMachine(rdzv_dir, ident, logger=logger, tick=tick)
+    sm.current_roster()  # adopt the live generation before proposing past it
+    sm.offer_join()
+    agreement = sm.agree(
+        lambda: sm.alive_procs() - sm.claimed_losses(),
+        epoch=0,
+        timeout_s=timeout_s,
+    )
+    payload = sm.establish(agreement, timeout_s=timeout_s)
+    sm.clear_join()
+    return sm, agreement, payload

@@ -139,6 +139,20 @@ def save_checkpoint(
         json.dump(clean, f)
 
 
+def materialize(tree) -> None:
+    """Block until every jax-array leaf of ``tree`` is materialized. Used
+    at recovery/restore boundaries so an async transfer's failure surfaces
+    AT the stage that dispatched it (attributable, retryable) instead of
+    poisoning a later stage's launches."""
+    jax.block_until_ready(
+        [
+            x
+            for x in jax.tree_util.tree_leaves(tree)
+            if hasattr(x, "block_until_ready")
+        ]
+    )
+
+
 def restore_checkpoint(
     ckpt_dir: str, state_template, template_fn=None
 ) -> Optional[Tuple[int, Any, Dict[str, Any]]]:
@@ -179,6 +193,11 @@ def restore_checkpoint(
         ocp.utils.to_shape_dtype_struct, state_template
     )
     state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    # materialize the raw restore before the re-place copies: orbax's
+    # transfers dispatch async, and surfacing their failure HERE (rather
+    # than poisoning the re-place launches downstream) is what lets the
+    # elastic recovery retry loop attribute and rebuild
+    materialize(state)
     # Re-place every leaf onto the live template's sharding: orbax restores
     # values, but default placement (single-device scalars) would poison the
     # next jit with mixed device sets — params must come back replicated over

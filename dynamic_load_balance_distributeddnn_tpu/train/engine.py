@@ -27,7 +27,7 @@ from __future__ import annotations
 import concurrent.futures
 import os
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -201,6 +201,9 @@ class Trainer:
         self.grad_comm = "flat"
         self._hier_hosts = 0
         self._link_bw: Optional[Dict] = None
+        # bandwidth-probe verdict memo: a reshard's host re-factor must not
+        # re-enable a structure the probe measured as a loss on this fabric
+        self._probe_gated_flat = False
         if cfg.grad_comm == "hier":
             from dynamic_load_balance_distributeddnn_tpu.parallel.topology import (
                 factor_hosts,
@@ -256,6 +259,7 @@ class Trainer:
                     )
                     self.grad_comm = "flat"
                     self._hier_hosts = 0
+                    self._probe_gated_flat = True
                     self.mesh = data_mesh(mesh_devices)
         else:
             self.mesh = data_mesh(mesh_devices)
@@ -267,11 +271,7 @@ class Trainer:
         # SPEC (sharded vs replicated optimizer) is part of the same
         # signature — a zero-1 program and a replicated one lower from
         # different state specs and must never resolve to each other.
-        self._comm_sig = (
-            ("hier", cfg.grad_comm_wire, self._hier_hosts)
-            if self.grad_comm == "hier"
-            else ("flat",)
-        ) + (("zero1",) if cfg.shard_update else ())
+        self._comm_sig = self._compute_comm_sig()
 
         self._setup_data(bundle)
         self._setup_model()
@@ -283,24 +283,7 @@ class Trainer:
         # (the lazy jit wrappers stay as fallback). aot_warm=False keeps the
         # legacy execute-to-compile warm loop as the A/B reference.
         self._aot: Optional[AOTCompileService] = None
-        if cfg.aot_warm:
-            self._aot = AOTCompileService(
-                workers=cfg.aot_pool,
-                logger=self.logger,
-                tick=heartbeat,
-                backend=cfg.aot_backend,
-                process_workers=cfg.aot_workers,
-                # workers write their own graftscope trace files next to the
-                # run trace; save_trace stitches them in (pid-tagged tracks)
-                trace_dir=cfg.trace_dir if cfg.trace != "off" else None,
-            )
-            self.steps.aot_service = self._aot
-            # tie the pool's lifetime to the trainer: processes that build
-            # many engines (the test tier, bench retry/insurance loops) must
-            # not accumulate idle non-daemon compile threads
-            import weakref
-
-            weakref.finalize(self, self._aot.close, False)
+        self._build_aot_service()
         self._aot_view_specs: Dict[int, object] = {}
         self._aot_dummy_template: list = []
         # world generation: bumped on every elastic re-shard and mixed into
@@ -369,6 +352,21 @@ class Trainer:
         # loss — a "not down" verdict there is the past, not a recovery
         self._lost_t: Dict[int, float] = {}
         self._hb_beacon = None
+        self._hb_beacon_path: Optional[str] = None
+        # Multi-host elasticity (ISSUE 14): the rendezvous state machine
+        # (armed with the peer beacon when DBS_PEER_HB_DIR is set) and the
+        # fleet's ORIGINAL process identities. ``proc_id``/``n_proc`` are
+        # the LIVE world's compact values and change across a re-rendezvous;
+        # ``_orig_proc_id``/``_proc_roster``/``_n_proc0`` speak the original
+        # ident space the heartbeat files, worker-rank ownership and the
+        # rendezvous protocol are keyed by. A respawned joiner carries its
+        # original ident in DBS_MH_IDENT (its live process index is whatever
+        # rank the grow rendezvous assigned).
+        self._rdzv = None
+        self._n_proc0 = self.n_proc
+        self._orig_proc_id = int(os.environ.get("DBS_MH_IDENT", self.proc_id))
+        self._proc_roster = list(range(self.n_proc))
+        self._peer_scan_cache = None
         if cfg.elastic == "on" and self.n_proc > 1:
             self._arm_peer_heartbeats()
 
@@ -507,6 +505,34 @@ class Trainer:
                 f"device cache: train arrays HBM-resident ({mb:.1f} MB), "
                 "epochs fed by index"
             )
+
+    def _build_aot_service(self) -> None:
+        """(Re)construct the AOT compile service. Re-run after a multi-host
+        re-rendezvous: the old pool's registry and any mid-flight lowerings
+        reference the RETIRED backend, so the recovery path closes the old
+        service and builds a fresh one against the new world."""
+        cfg = self.cfg
+        self._aot = None
+        if not cfg.aot_warm:
+            return
+        self._aot = AOTCompileService(
+            workers=cfg.aot_pool,
+            logger=self.logger,
+            tick=heartbeat,
+            backend=cfg.aot_backend,
+            process_workers=cfg.aot_workers,
+            # workers write their own graftscope trace files next to the
+            # run trace; save_trace stitches them in (pid-tagged tracks)
+            trace_dir=cfg.trace_dir if cfg.trace != "off" else None,
+        )
+        if getattr(self, "steps", None) is not None:
+            self.steps.aot_service = self._aot
+        # tie the pool's lifetime to the trainer: processes that build
+        # many engines (the test tier, bench retry/insurance loops) must
+        # not accumulate idle non-daemon compile threads
+        import weakref
+
+        weakref.finalize(self, self._aot.close, False)
 
     def _decide_device_cache(self) -> bool:
         cfg = self.cfg
@@ -1599,6 +1625,64 @@ class Trainer:
         # controller vector resets to uniform rather than poisoning the
         # solver with a wrong-shaped state.
         saved_active = controller.get("active_ranks")
+        if self.cfg.elastic == "on" and self.n_proc > 1:
+            # Multi-host: the LIVE rendezvous roster is authoritative, not
+            # the checkpoint stamp — a joiner restoring a shrink-era
+            # checkpoint (stamped with the survivor fleet) is entering the
+            # GROWN world its join rendezvous just established
+            live = sorted(
+                r
+                for r in range(self.cfg.world_size)
+                if self._proc_of_rank(r) in set(self._proc_roster)
+            )
+            if live != self.active_ranks:
+                self._reshard_world(live)
+                self.state = retry_transient(
+                    lambda: self._state_from_host(
+                        self._state_to_host(self.state)
+                    ),
+                    logger=self.logger,
+                    desc="resume state re-placement",
+                    tick=heartbeat,
+                )
+                self._fix_comm_residual()
+                for r in range(self.cfg.world_size):
+                    if r not in self.active_ranks:
+                        self.health.mark_down(r)
+            base = (
+                [int(r) for r in saved_active]
+                if saved_active
+                and all(
+                    0 <= int(r) < self.cfg.world_size for r in saved_active
+                )
+                else list(self.active_ranks)
+            )
+            if "shares" in controller and len(controller["shares"]) == len(
+                base
+            ):
+                self._adopt_controller_vectors(
+                    base,
+                    controller["shares"],
+                    controller.get("node_times", controller["shares"]),
+                )
+            elif "shares" in controller:
+                # a stamp from a different world layout: keep the fresh
+                # uniform vectors rather than poisoning the solver — same
+                # contract as the single-process resume path below
+                self.logger.warning(
+                    f"Resume: sidecar vectors ({len(controller['shares'])} "
+                    f"entries) do not match the stamped fleet "
+                    f"({len(base)}) — resetting to uniform"
+                )
+            if "total_wallclock" in controller:
+                self.total_wallclock = float(controller["total_wallclock"])
+            if "total_probe_s" in controller:
+                self.total_probe_s = float(controller["total_probe_s"])
+            self.logger.info(
+                f"Resumed from checkpoint at epoch {epoch} over the live "
+                f"fleet {self.active_ranks} (roster {self._proc_roster})"
+            )
+            return epoch + 1
         if (
             self.cfg.elastic == "on"
             and saved_active is not None
@@ -1616,6 +1700,7 @@ class Trainer:
                 desc="resume state re-placement",
                 tick=heartbeat,
             )
+            self._fix_comm_residual()
             for r in range(self.cfg.world_size):
                 if r not in self.active_ranks:
                     self.health.mark_down(r)
@@ -1660,22 +1745,60 @@ class Trainer:
     # at the next epoch boundary with a probe-seeded share.
 
     def _arm_peer_heartbeats(self) -> None:
-        """Multi-host detection: each process beacons its own heartbeat
-        file under DBS_PEER_HB_DIR; health checks scan peers for staleness
-        (and the watchdog's exit-reason tag). Recovery across processes is
-        NOT attempted — a dead peer means the global mesh is gone — but
-        detection turns a silent collective hang into a diagnosed abort."""
+        """Multi-host detection + recovery channel: each process beacons its
+        own heartbeat file under DBS_PEER_HB_DIR; health checks scan peers
+        for staleness (and the watchdog's exit-reason tag), and the SAME
+        directory carries the re-rendezvous protocol files
+        (runtime/rendezvous.py) — a confirmed peer-process loss is survived
+        by tearing down ``jax.distributed`` and re-initializing over the
+        survivor roster at the epoch boundary (``_recover_multihost``).
+        Workers that want that recovery must have brought the world up
+        through ``rendezvous.elastic_initialize`` (a stock-initialized
+        world's coordination service aborts every survivor on peer death);
+        detection alone works either way."""
         hb_dir = os.environ.get("DBS_PEER_HB_DIR")
         if not hb_dir:
             return
         from dynamic_load_balance_distributeddnn_tpu.runtime.health import (
             ProcessHeartbeat,
         )
+        from dynamic_load_balance_distributeddnn_tpu.runtime.rendezvous import (
+            RendezvousStateMachine,
+        )
 
+        self._rdzv = RendezvousStateMachine(
+            hb_dir, self._orig_proc_id, logger=self.logger
+        )
+        roster = self._rdzv.current_roster()
+        if len(roster) == self.n_proc:
+            self._proc_roster = roster
+        # the ORIGINAL fleet shape anchors worker-rank ownership
+        # (_ranks_of_proc slices world_size by the GEN-0 process count). A
+        # long-lived survivor inherited it from its own gen-0 n_proc, but a
+        # respawned JOINER builds its engine inside the grown world — if
+        # the fleet grew back to fewer processes than gen 0 had, the live
+        # process count is the WRONG divisor. ack_g0 records the original
+        # roster; adopt its size when present.
+        import json as _json
+
+        try:
+            with open(os.path.join(hb_dir, "ack_g0.json")) as f:
+                g0 = _json.load(f)
+            roster0 = [int(p) for p in g0.get("roster", ())]
+            if roster0 and len(roster0) != self._n_proc0:
+                self.logger.info(
+                    f"elastic: adopting generation-0 fleet shape "
+                    f"({len(roster0)} processes) for rank ownership "
+                    f"(live world has {self.n_proc})"
+                )
+                self._n_proc0 = len(roster0)
+        except (OSError, ValueError):
+            pass
         self._hb_beacon = ProcessHeartbeat(
             period_s=float(os.environ.get("DBS_PEER_HB_PERIOD_S", "1.0"))
         )
-        beacon_path = self._hb_beacon.beacon(hb_dir, f"proc{self.proc_id}")
+        beacon_path = self._hb_beacon.beacon(hb_dir, f"proc{self._orig_proc_id}")
+        self._hb_beacon_path = beacon_path
         # a stall-watchdog abort must be readable by the PEERS too, not just
         # the parent watching this process's own heartbeat file — register
         # the beacon so the abort path tags it with the exit reason
@@ -1702,18 +1825,20 @@ class Trainer:
         # the watcher thread still sees the stale pulse, logs it, and drops
         # a marker file the launcher (bench retry loop, test harness) reads
         stale_s = float(os.environ.get("DBS_PEER_HB_STALE_S", "10.0"))
-        peers = [f"proc{p}" for p in range(self.n_proc) if p != self.proc_id]
+        peers = [
+            f"proc{p}" for p in self._proc_roster if p != self._orig_proc_id
+        ]
         # the callback must not capture self either: the WATCHER thread
         # holds it, and a closed-over trainer would be pinned reachable —
         # the finalize above would then never fire
-        logger, proc_id = self.logger, self.proc_id
+        logger, proc_id = self.logger, self._orig_proc_id
 
         def _on_stale(ident: str, info: dict) -> None:
             reason = ProcessHeartbeat.stale_reason(info)
             logger.warning(
-                f"elastic: peer {ident} unreachable ({reason}) — the global "
-                "mesh cannot survive a lost process; expect the collective "
-                "to hang until the watchdog aborts or the peer returns"
+                f"elastic: peer {ident} unreachable ({reason}) — survivors "
+                "will re-rendezvous at the next boundary (a wedged "
+                "collective against the dead peer errors or aborts first)"
             )
             try:
                 with open(
@@ -1729,26 +1854,47 @@ class Trainer:
                 pass
 
         self._hb_beacon.watch(hb_dir, peers, stale_s, _on_stale)
+        # re-armable watcher factory for fleet growth (a rejoined peer — or
+        # one the original watcher already fired on — needs a fresh watch
+        # thread; closures capture the beacon, never self)
+        beacon_ref = self._hb_beacon
+        self._peer_watch = lambda idents: beacon_ref.watch(
+            hb_dir, idents, stale_s, _on_stale
+        )
         self.logger.info(
             f"elastic: process heartbeat beacon + peer watcher armed under "
             f"{hb_dir}"
         )
 
-    def _scan_peer_heartbeats(self) -> set:
+    def _ranks_of_proc(self, p: int) -> range:
+        """ORIGINAL worker ranks owned by ORIGINAL process ``p`` — the
+        gen-0 contiguous slice, invariant across re-rendezvous (compact
+        runtime ranks re-derive from these via ``active_ranks``)."""
+        wsp = self.cfg.world_size // max(self._n_proc0, 1)
+        return range(p * wsp, (p + 1) * wsp)
+
+    def _proc_of_rank(self, r: int) -> int:
+        return int(r) // (self.cfg.world_size // max(self._n_proc0, 1))
+
+    def _scan_peer_heartbeats(self, force: bool = False) -> set:
         """Original ranks owned by peers whose heartbeat files went stale
-        (multi-host only). Single-process runs return an empty set.
-        Throttled to the heartbeat period: this runs at every window
-        boundary inside the timed epoch, and a fresh listdir + per-file
-        read there cannot learn anything a sub-period rescan didn't —
-        while on a slow shared filesystem it would bill real I/O stalls
-        to the epoch wall."""
+        (multi-host only) — plus ranks of peers another SURVIVOR already
+        claimed lost for this generation (rendezvous loss files), so
+        detection stays coherent across survivors whose beacon scans lag.
+        Single-process runs return an empty set. Throttled to the heartbeat
+        period (``force`` bypasses — the collective-failure attribution
+        path needs a fresh verdict NOW): this runs at every window boundary
+        inside the timed epoch, and a fresh listdir + per-file read there
+        cannot learn anything a sub-period rescan didn't — while on a slow
+        shared filesystem it would bill real I/O stalls to the epoch
+        wall."""
         hb_dir = os.environ.get("DBS_PEER_HB_DIR")
         if not hb_dir or self.n_proc == 1:
             return set()
         period_s = float(os.environ.get("DBS_PEER_HB_PERIOD_S", "1.0"))
         now = time.perf_counter()
-        cached = getattr(self, "_peer_scan_cache", None)
-        if cached is not None and now - cached[0] < period_s:
+        cached = self._peer_scan_cache
+        if not force and cached is not None and now - cached[0] < period_s:
             return cached[1]
         from dynamic_load_balance_distributeddnn_tpu.runtime.health import (
             ProcessHeartbeat,
@@ -1757,8 +1903,16 @@ class Trainer:
         stale_s = float(os.environ.get("DBS_PEER_HB_STALE_S", "10.0"))
         down: set = set()
         scan = ProcessHeartbeat.scan(hb_dir)
-        for p in range(self.n_proc):
-            if p == self.proc_id:
+        claimed = (
+            self._rdzv.claimed_losses() if self._rdzv is not None else set()
+        )
+        for p in self._proc_roster:
+            if p == self._orig_proc_id:
+                continue
+            if p in claimed:
+                # another survivor's published verdict: adopt it instead of
+                # dispatching one more collective against the dead process
+                down.update(self._ranks_of_proc(p))
                 continue
             info = scan.get(f"proc{p}")
             if info is None:
@@ -1768,8 +1922,7 @@ class Trainer:
                     f"elastic: peer process {p} unreachable "
                     f"({ProcessHeartbeat.stale_reason(info)})"
                 )
-                lo = p * (self.cfg.world_size // self.n_proc)
-                down.update(range(lo, lo + self.cfg.world_size // self.n_proc))
+                down.update(self._ranks_of_proc(p))
         self._peer_scan_cache = (now, down)
         return down
 
@@ -1836,6 +1989,25 @@ class Trainer:
                     )
                     raise
                 self._recover(e.ranks, epoch)
+            except Exception as e:  # noqa: BLE001 — attributed or re-raised
+                # multi-host: a peer dying MID-collective surfaces as the
+                # collective's error (closed socket) long before any window-
+                # boundary health check runs — attribute it to the peer
+                # verdict before treating it as fatal
+                lost = self._attribute_collective_failure(e, epoch)
+                if lost is None:
+                    raise
+                if self._recoveries >= self.cfg.elastic_max_recoveries:
+                    self.logger.error(
+                        f"elastic: recovery budget exhausted "
+                        f"({self._recoveries}) — giving up"
+                    )
+                    raise
+                self.logger.warning(
+                    f"elastic: dispatch failure attributed to lost "
+                    f"worker(s) {lost} — recovering"
+                )
+                self._recover(lost, epoch)
 
     def _snapshot_epoch_state(self) -> None:
         """Host-copy of the TrainState + controller vectors at the epoch
@@ -1936,15 +2108,50 @@ class Trainer:
                 # first post-restore epoch)
                 leaf = jnp.array(val, copy=True)
             if committed:
-                leaf = jax.device_put(leaf, sh)
+                if self.n_proc > 1:
+                    # collective-free placement: device_put to a
+                    # non-fully-addressable sharding runs assert_equal's
+                    # hidden gloo broadcast, and the multi-host recovery /
+                    # grow paths run ASYMMETRIC code across processes — an
+                    # unmatched broadcast there pairs with the wrong
+                    # collective on the peer. Every process holds the
+                    # identical host snapshot, so assembling from local
+                    # per-device copies is exact.
+                    leaf = jax.make_array_from_single_device_arrays(
+                        leaf.shape,
+                        sh,
+                        [
+                            jax.device_put(leaf, d)
+                            for d in sh.addressable_devices
+                        ],
+                    )
+                else:
+                    leaf = jax.device_put(leaf, sh)
             leaves.append(leaf)
         return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _compute_comm_sig(self) -> tuple:
+        """AOT-key / plan-layout signature of the combine structure (see the
+        __init__ comment) — recomputed on every fleet change: an elastic
+        re-shard can re-factor hier hosts or fall back to flat, and the two
+        structures lower different programs that must never resolve to each
+        other."""
+        return (
+            ("hier", self.cfg.grad_comm_wire, self._hier_hosts)
+            if self.grad_comm == "hier"
+            else ("flat",)
+        ) + (("zero1",) if self.cfg.shard_update else ())
 
     def _reshard_world(self, active: List[int]) -> None:
         """Point the engine at a new active fleet: compact controller
         vectors, survivor topology/mesh, a fresh StepLibrary against it,
         and every mesh/topology-keyed cache invalidated. The caller re-
-        places the TrainState afterwards (`_state_from_host`)."""
+        places the TrainState afterwards (`_state_from_host`). Multi-host:
+        called AFTER a re-rendezvous re-initialized ``jax.distributed``
+        over the survivor roster — ``jax.devices()`` is already the new
+        global fleet and ``proc_id``/``n_proc``/``_proc_roster`` its
+        compact shape; each surviving process keeps its own worker slice
+        (loss is process-granular across hosts)."""
         cfg = self.cfg
         self.active_ranks = sorted(int(r) for r in active)
         # topology fields below are read by the pipeline's gather/stage
@@ -1955,20 +2162,88 @@ class Trainer:
         self.world_size = len(self.active_ranks)  # graftlint: disable=G012
         if self.world_size < 1:
             raise RuntimeError("elastic: no surviving workers")
-        self.ws_local = self.world_size  # graftlint: disable=G012
-        self.rank_lo = 0  # graftlint: disable=G012
         local_devices = sorted(jax.local_devices(), key=lambda d: d.id)
         ids_global = cfg.worker_device_ids(len(local_devices))
-        ids_active = [ids_global[r] for r in self.active_ranks]
-        used = sorted(set(ids_active))
-        self.topology = WorkerTopology.build(
-            self.world_size,
-            [local_devices[i] for i in used],
-            [used.index(i) for i in ids_active],
-        )
-        mesh_devices = list(self.topology.devices)
-        self.mesh = data_mesh(mesh_devices)
+        if self.n_proc > 1:
+            # my workers: the slice of ORIGINAL ranks this process owned at
+            # gen 0 (whole peers die; survivors keep their full slice).
+            # Compact runtime ranks are positions in sorted(active), and my
+            # originals are contiguous there — roster order (sorted original
+            # ids) matches original-rank order by construction.
+            mine = [
+                r for r in self.active_ranks
+                if self._proc_of_rank(r) == self._orig_proc_id
+            ]
+            if not mine:
+                raise RuntimeError(
+                    "elastic: this process owns no surviving workers"
+                )
+            self.ws_local = len(mine)  # graftlint: disable=G012
+            self.rank_lo = self.active_ranks.index(mine[0])  # graftlint: disable=G012
+            ids_local = [ids_global[r] for r in mine]
+            used = sorted(set(ids_local))
+            self.topology = WorkerTopology.build(
+                self.ws_local,
+                [local_devices[i] for i in used],
+                [used.index(i) for i in ids_local],
+            )
+            # global combine mesh: every surviving process contributes the
+            # same local device ordinals (symmetry validated at __init__),
+            # ordered by the NEW process index — which is roster order
+            by_proc: Dict[int, list] = {}
+            for d in jax.devices():
+                by_proc.setdefault(d.process_index, []).append(d)
+            mesh_devices = []
+            for p in sorted(by_proc):
+                proc_devs = sorted(by_proc[p], key=lambda d: d.id)
+                mesh_devices.extend(proc_devs[i] for i in used)
+        else:
+            self.ws_local = self.world_size  # graftlint: disable=G012
+            self.rank_lo = 0  # graftlint: disable=G012
+            ids_active = [ids_global[r] for r in self.active_ranks]
+            used = sorted(set(ids_active))
+            self.topology = WorkerTopology.build(
+                self.world_size,
+                [local_devices[i] for i in used],
+                [used.index(i) for i in ids_active],
+            )
+            mesh_devices = list(self.topology.devices)
+        # hier×elastic (ISSUE 14 satellite): re-factor the survivors into
+        # host groups so elastic runs KEEP the two-level combine when the
+        # surviving devices still form equal contiguous host blocks (real
+        # process topology, or the synthetic --hier_hosts split); otherwise
+        # fall back to the flat combine — logged once, and the re-keyed
+        # _comm_sig makes the structure change a new compiled-program
+        # universe (no hier executable can resolve against a flat world).
+        prev_comm = self.grad_comm
+        self.grad_comm = "flat"
+        self._hier_hosts = 0
+        if cfg.grad_comm == "hier" and not self._probe_gated_flat:
+            from dynamic_load_balance_distributeddnn_tpu.parallel.topology import (
+                factor_hosts,
+            )
+
+            hosts = factor_hosts(mesh_devices, requested=cfg.hier_hosts)
+            if hosts is not None:
+                self.grad_comm = "hier"
+                self._hier_hosts = hosts
+            else:
+                self.logger.warning(
+                    f"grad_comm=hier: the {len(mesh_devices)}-device survivor "
+                    "fleet no longer factors into equal contiguous host "
+                    "blocks — falling back to the flat combine"
+                    + (" (was hier)" if prev_comm == "hier" else "")
+                )
+        if self.grad_comm == "hier":
+            from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+                hier_mesh,
+            )
+
+            self.mesh = hier_mesh(mesh_devices, self._hier_hosts)
+        else:
+            self.mesh = data_mesh(mesh_devices)
         self.n_dev = len(mesh_devices)
+        self._comm_sig = self._compute_comm_sig()
         if cfg.shard_update:
             # the 1/N optimizer chunk layout is sized by the DEVICE count:
             # a survivor fleet re-pads the flat state to its own multiple
@@ -1984,6 +2259,7 @@ class Trainer:
         # mesh/topology-keyed caches: all stale the moment the fleet changed
         self._aot_gen += 1
         self._aot_view_specs = {}
+        self._peer_scan_cache = None
         self._cache_repl = None
         self._cache_dev = {}
         self._eval_chunk_cache = None
@@ -2020,11 +2296,28 @@ class Trainer:
         exponential-backoff retries — a re-shard can race the dying
         runtime's teardown."""
         if self.n_proc > 1:
-            raise RuntimeError(
-                f"elastic: worker(s) {lost} lost but recovery is "
-                "single-process only — a dead peer process takes the global "
-                "mesh with it (see README 'Fault tolerance')"
-            )
+            if self._rdzv is None:
+                raise RuntimeError(
+                    f"elastic: worker(s) {lost} lost but no rendezvous "
+                    "channel is armed (set DBS_PEER_HB_DIR and bring the "
+                    "world up through rendezvous.elastic_initialize) — "
+                    "aborting for resume-from-checkpoint (see README "
+                    "'Fault tolerance')"
+                )
+            if all(self._proc_of_rank(r) == self._orig_proc_id for r in lost):
+                # a loss confined to THIS process's own workers: peers see
+                # a live beacon and no claim, so they would never enter the
+                # rendezvous — proposing one just wedges the fleet for the
+                # full phase timeout. Abort with the honest verdict
+                # instead (resume-from-checkpoint restarts the fleet).
+                raise RuntimeError(
+                    f"elastic: worker(s) {sorted(lost)} on THIS process "
+                    "confirmed lost in a multi-process world — a "
+                    "single-process worker shrink cannot change the "
+                    "global mesh and no peer would join a rendezvous for "
+                    "it; aborting for resume-from-checkpoint"
+                )
+            return self._recover_multihost(lost, epoch)
         cfg = self.cfg
         t0 = self._detect_t0 or time.perf_counter()
         snap = self._epoch_snap
@@ -2076,6 +2369,7 @@ class Trainer:
                 self.node_times = self.node_times[sel]
                 self.per_example_cost = self.per_example_cost[sel]
                 self.state = self._state_from_host(self._state_to_host(self.state))
+            self._fix_comm_residual()
             jax.block_until_ready(self.state.params)
             heartbeat()  # survivor mesh answered — recovery pipeline is live
             self._recoveries += 1
@@ -2095,6 +2389,468 @@ class Trainer:
                 "training); epoch re-runs from the consistent snapshot"
             )
 
+    # ------------------------------------------ multi-host re-rendezvous
+    # (ISSUE 14). jax cannot shrink a live multi-host mesh, so surviving a
+    # peer-PROCESS loss means rebuilding the world: survivors reach roster
+    # consensus through the heartbeat-file directory (propose -> agree),
+    # tear down ``jax.distributed`` (retiring the old runtime — see
+    # runtime/rendezvous.py for why the retired objects deliberately leak),
+    # re-initialize over the survivor set on a fresh coordinator port
+    # (barrier -> establish), re-shard topology/mesh/StepLibrary onto the
+    # survivor fleet, restore from the flushed checkpoint re-placed onto the
+    # survivor mesh, and re-run the interrupted epoch — bitwise-identical to
+    # a fresh reduced-world run from the same checkpoint. A failed or
+    # timed-out rendezvous degrades to the pre-ISSUE-14 abort-and-resume
+    # ladder, logged with the phase that died.
+
+    def _fix_comm_residual(self) -> None:
+        """Re-base the error-feedback residual on the CURRENT combine
+        structure after a fleet change: the old world's ``[n_dev, chunk]``
+        rows are meaningless on a different device count (and their stale
+        shape would fork every state-fed executable signature), so a hier
+        survivor mesh re-attaches zeros — error feedback re-accumulates
+        within an epoch — and a re-factor that fell back to flat drops the
+        leaf entirely."""
+        st = self.state
+        if getattr(st, "comm_residual", None) is None and self.grad_comm != "hier":
+            return
+        st = st.replace(comm_residual=None)
+        if self.grad_comm == "hier":
+            from dynamic_load_balance_distributeddnn_tpu.train.state import (
+                attach_comm_residual,
+            )
+
+            st = attach_comm_residual(
+                st, self.mesh,
+                pad_multiple=self.n_dev if self.cfg.shard_update else 0,
+            )
+        self.state = st
+
+    def _adopt_controller_vectors(
+        self, base_active, shares, node_times, cost=None
+    ) -> None:
+        """Seed the compact controller vectors for the CURRENT active fleet
+        from a PREVIOUS fleet's vectors (checkpoint sidecar or epoch
+        snapshot): survivors keep their entries, newcomers fill with the
+        survivor mean, shares renormalize. Pure function of
+        (source vectors, rosters), so every surviving process — and a
+        freshly joined one reading the same sidecar — derives the identical
+        seed (the replicated-controller contract across a fleet change)."""
+        base = [int(r) for r in base_active]
+        sel = {r: i for i, r in enumerate(base)}
+
+        def fill(vec, fallback):
+            src = np.asarray(vec, dtype=np.float64)
+            out = np.full(self.world_size, np.nan)
+            for i, r in enumerate(self.active_ranks):
+                if r in sel and sel[r] < len(src):
+                    out[i] = src[sel[r]]
+            mean = np.nanmean(out) if np.isfinite(out).any() else fallback
+            out[~np.isfinite(out)] = mean
+            return out
+
+        sh = fill(shares, 1.0 / max(self.world_size, 1))
+        self.shares = sh / max(sh.sum(), 1e-12)
+        self.node_times = np.maximum(fill(node_times, 1.0), 1e-9)
+        if cost is not None:
+            self.per_example_cost = fill(cost, np.nan)
+        else:
+            self.per_example_cost = np.full(self.world_size, np.nan)
+
+    def _mh_rdzv_failed(self, e: Exception, epoch: int) -> None:
+        """A rendezvous phase died (hard timeout, eviction, connect
+        failure): degrade to the pre-ISSUE-14 abort-and-resume ladder —
+        loudly. The beacon file is tagged with the failed phase so peers
+        (and the launching harness) diagnose the abort instead of reading a
+        silent freeze, the event lands in the recorder meta, and the raise
+        unwinds the run for the outer retry/resume loop."""
+        phase = getattr(e, "phase", "unknown")
+        msg = (
+            f"elastic: multi-host re-rendezvous FAILED in phase "
+            f"'{phase}' ({e}) — degrading to abort-and-resume-from-"
+            "checkpoint"
+        )
+        self.logger.error(msg)
+        if self._hb_beacon_path:
+            from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import (
+                tag_exit_reason,
+            )
+
+            tag_exit_reason(
+                self._hb_beacon_path, f"rendezvous failed: {phase}"
+            )
+        self._elastic_events.append(
+            {"epoch": int(epoch), "rdzv_failed_phase": str(phase)}
+        )
+        self.recorder.meta["elastic_events"] = self._elastic_events
+        raise RuntimeError(msg) from e
+
+    def _recover_multihost(self, lost: List[int], epoch: int) -> None:
+        """Confirmed PEER-PROCESS loss on the multi-host tier: publish the
+        loss verdict (peers with lagging beacon scans adopt it instead of
+        dispatching another collective at the dead process), then run the
+        epoch-boundary re-rendezvous over the survivors."""
+        cfg = self.cfg
+        if cfg.shard_update:
+            # recorded exclusion: re-chunking the 1/N optimizer state across
+            # a multi-host re-rendezvous needs a sharded process-local
+            # restore path the engine does not build yet (ROADMAP)
+            raise RuntimeError(
+                f"elastic: worker(s) {sorted(lost)} lost but multi-host "
+                "re-rendezvous does not compose with --shard_update yet — "
+                "aborting for resume-from-checkpoint"
+            )
+        dead_procs = sorted(
+            {self._proc_of_rank(r) for r in lost}
+            - {self._orig_proc_id}
+        )
+        self.logger.warning(
+            f"elastic: worker(s) {sorted(lost)} (peer process(es) "
+            f"{dead_procs}) confirmed lost at epoch {epoch} — "
+            "re-rendezvousing over survivors"
+        )
+        for r in lost:
+            self.health.mark_down(r)
+        self._rdzv.claim_loss(dead_procs, epoch)
+        survivors = [r for r in self.active_ranks if r not in set(lost)]
+        self._mh_rerendezvous(epoch, survivors, lost=sorted(lost))
+
+    def _maybe_regrow_multihost(self, epoch: int) -> None:
+        """Epoch-boundary grow: (re)spawned processes that offered to join
+        (``join_p*.json`` + a fresh beacon) are admitted by re-running the
+        same rendezvous with them in the roster. Newcomers seed at the
+        survivor-mean share (a cross-process probe exchange is a recorded
+        follow-up); their engine restores from the shared checkpoint and
+        adopts the agreed fleet."""
+        if self._rdzv is None:
+            return
+        alive = self._rdzv.alive_procs()
+        joins = sorted(
+            p
+            for p in self._rdzv.pending_joins()
+            if p in alive and p not in set(self._proc_roster)
+        )
+        if not joins:
+            return
+        if not self.cfg.ckpt_dir:
+            # the joiner's ONLY state source is the shared checkpoint (the
+            # survivors restore the same bytes so the grown world stays
+            # replicated) — admitting one without a ckpt_dir would psum
+            # fresh-init params against the trained ones, silently
+            # diverging every process. Refuse loudly, once per epoch.
+            self.logger.warning(
+                f"elastic: process(es) {joins} offered to join at epoch "
+                f"{epoch} but no --ckpt_dir is configured — a joiner "
+                "cannot adopt the replicated state; refusing the grow"
+            )
+            return
+        self.logger.info(
+            f"elastic: process(es) {joins} offering to join at epoch "
+            f"{epoch} — re-rendezvousing to grow the fleet"
+        )
+        active = sorted(
+            set(self.active_ranks)
+            | {r for p in joins for r in self._ranks_of_proc(p)}
+        )
+        self._mh_rerendezvous(epoch, active, joining=joins)
+        for p in joins:
+            self._rdzv.clear_join(p)
+
+    def _mh_rerendezvous(
+        self,
+        epoch: int,
+        target_active: List[int],
+        lost: Sequence[int] = (),
+        joining: Sequence[int] = (),
+    ) -> None:
+        """The shared shrink/grow spine: drain -> flush -> agree -> retire
+        -> establish -> re-shard -> restore -> re-seed. Every blocking phase
+        is armored (bounded timeouts in the state machine, retry_transient
+        on the collective edges, heartbeat ticks throughout), and a failed
+        phase degrades through :meth:`_mh_rdzv_failed` instead of hanging."""
+        from dynamic_load_balance_distributeddnn_tpu.runtime import (
+            rendezvous as rdzv,
+        )
+        from dynamic_load_balance_distributeddnn_tpu.train.checkpoint import (
+            flush_checkpoints,
+            materialize,
+            restore_checkpoint,
+        )
+
+        cfg = self.cfg
+        t0 = self._detect_t0 or time.perf_counter()
+        with self._trace.span("recover_mh", cat="recover"):
+            # 1. durable checkpoint, manager CLOSED: the cached orbax
+            # manager's async machinery holds old-world device arrays and
+            # must drain and die before the runtime is retired under it
+            if cfg.ckpt_dir:
+                flush_checkpoints(cfg.ckpt_dir, close=True)
+                heartbeat()
+            # 2. host-side recovery source. Shrink resumes the interrupted
+            # epoch from its START snapshot (== the flushed checkpoint);
+            # grow runs at a boundary, so the LIVE state is the source.
+            snap = self._epoch_snap if not joining else None
+            if snap is not None:
+                host_state = snap["state"]
+                prev_active = list(snap["active"])
+                src = {
+                    "shares": snap["shares"],
+                    "node_times": snap["node_times"],
+                    "cost": snap["per_example_cost"],
+                }
+                self.total_wallclock = snap["total_wallclock"]
+                self.total_probe_s = snap["total_probe_s"]
+            else:
+                host_state = self._state_to_host(self.state)
+                prev_active = list(self.active_ranks)
+                src = {
+                    "shares": self.shares.copy(),
+                    "node_times": self.node_times.copy(),
+                    "cost": self.per_example_cost.copy(),
+                }
+            # 3. roster consensus (propose -> agree): bounded rounds, hard
+            # per-phase timeout, watchdog ticks — a wedged peer times the
+            # rendezvous out instead of hanging it
+            try:
+                agreement = self._rdzv.agree(
+                    lambda: (
+                        self._rdzv.alive_procs() - self._rdzv.claimed_losses()
+                    ),
+                    epoch,
+                )
+            except rdzv.RendezvousError as e:
+                self._mh_rdzv_failed(e, epoch)
+            roster = list(agreement.roster)
+            # the agreed roster is authoritative: drop ranks whose process
+            # died DURING the rendezvous, admit one that raced its join in
+            active = [
+                r for r in target_active if self._proc_of_rank(r) in set(roster)
+            ]
+            for p in roster:
+                if all(self._proc_of_rank(r) != p for r in active):
+                    active.extend(self._ranks_of_proc(p))
+            active = sorted(set(active))
+            # 4. quiesce every device-holding surface, then retire the old
+            # runtime (client/service leak deliberately — rendezvous.py)
+            if self._aot is not None:
+                try:
+                    self._aot.close(wait=True)
+                except Exception as e:  # noqa: BLE001 — a dying pool must not block recovery
+                    self.logger.warning(
+                        f"elastic: AOT service close failed ({e!r}) — "
+                        "continuing recovery"
+                    )
+                self._aot = None
+            self.state = None
+            self._cache_repl = None
+            self._cache_dev = {}
+            self._epoch_snap = None  # re-snapshotted when the epoch re-runs
+            # force the dying world's wedged collectives to resolve BEFORE
+            # the new world exists — unresolved, they poison the next
+            # backend's launches through XLA:CPU's process-global
+            # rendezvous map (see rendezvous.drain_collective_chain)
+            rdzv.drain_collective_chain(logger=self.logger, tick=heartbeat)
+            rdzv.retire_runtime()
+            # 5. barrier on every survivor's teardown, leader brings up the
+            # new coordination service, everyone connects
+            try:
+                # the payload is for JOINERS (join_elastic_world returns it);
+                # survivors are replicated-deterministic and ignore it
+                self._rdzv.establish(
+                    agreement,
+                    payload=(
+                        {"epoch": int(agreement.epoch), "active": active}
+                        if agreement.leader
+                        else None
+                    ),
+                )
+            except rdzv.RendezvousError as e:
+                self._mh_rdzv_failed(e, epoch)
+            # 6. adopt the new world shape; rebuild the compile service and
+            # every topology/mesh surface against it. The whole rebuild tail
+            # runs under a bounded retry: the dead world's wedged collective
+            # resolves at an ARBITRARY later moment (gloo socket teardown is
+            # async), and whatever multi-device dispatch is in flight right
+            # then inherits its error — the canary (quarantine_runtime)
+            # catches an inheritance that already landed, the final
+            # block_until_ready catches one that landed mid-rebuild, and a
+            # poisoned attempt tears the backend down and rebuilds from
+            # scratch (cheap: ~0.3s on the CPU tier). Recorded limitation:
+            # retry counts are process-local, so divergence across MULTIPLE
+            # survivors is not handled (the CPU-tier shrink target is a
+            # single surviving process; see quarantine_runtime).
+            self.n_proc = len(roster)
+            self.proc_id = agreement.rank
+            self._proc_roster = roster
+            restored_from = "epoch snapshot"
+            ctl = None
+            rebuild_err: Optional[Exception] = None
+            for attempt in range(5):
+                try:
+                    rdzv.quarantine_runtime(logger=self.logger, tick=heartbeat)
+                except rdzv.RendezvousError as e:
+                    self._mh_rdzv_failed(e, epoch)
+                # a silent async failure in the preceding stage surfaces at
+                # the canary instead of poisoning the next stage's launches
+                # (local devices only — see rendezvous.local_canary_launch;
+                # on the GROW path the joiner runs no matching canary, so a
+                # global-mesh put's hidden gloo broadcast would pair with
+                # the joiner's first real collective)
+                _launch_canary = rdzv.local_canary_launch
+
+                stage = "reshard"
+                try:
+                    self._reshard_world(active)
+                    _launch_canary()
+                    # 7. restore: the flushed checkpoint re-placed onto the
+                    # survivor mesh (falling back to the epoch-start
+                    # snapshot when no checkpoint directory is configured or
+                    # the latest step is not the interrupted epoch's
+                    # boundary)
+                    stage = "template"
+                    template = self._state_from_host(host_state)
+                    materialize(template)
+                    _launch_canary()
+                    stage = "restore"
+                    self.state = template
+                    restored_from = "epoch snapshot"
+                    ctl = None
+                    # the GROW path restores from the flushed checkpoint
+                    # too (identical bytes to the live boundary state): the
+                    # JOINER's only state source is that checkpoint, and its
+                    # engine restores through the same restore_checkpoint
+                    # call — orbax's manager-create/restore syncs are global
+                    # collectives, so the survivor must run the SAME
+                    # sequence at the same program point or the joiner's
+                    # syncs pair with the wrong launch (see _launch_canary)
+                    if cfg.ckpt_dir:
+                        got = restore_checkpoint(cfg.ckpt_dir, template)
+                        if got is not None and int(got[0]) == epoch - 1:
+                            self.state, ctl = got[1], got[2]
+                            restored_from = f"checkpoint[{int(got[0])}]"
+                        elif got is not None:
+                            self.logger.warning(
+                                f"elastic: latest checkpoint is epoch "
+                                f"{got[0]}, not {epoch - 1} — resuming from "
+                                "the epoch-start snapshot instead"
+                            )
+                    _launch_canary()
+                    stage = "fix-residual"
+                    self._fix_comm_residual()
+                    stage = "materialize"
+                    # materialize EVERYTHING state-shaped before declaring
+                    # the world live — a poisoned buffer must surface here,
+                    # inside the retry scope, not an epoch later
+                    materialize(self.state)
+                    rebuild_err = None
+                    break
+                except Exception as e:  # noqa: BLE001 — poisoned-world rebuild
+                    rebuild_err = e
+                    self.state = None
+                    self._cache_repl = None
+                    self._cache_dev = {}
+                    self.logger.warning(
+                        f"elastic: survivor-world rebuild attempt "
+                        f"{attempt + 1} inherited the dead world's dispatch "
+                        f"chain at stage '{stage}' ({str(e)[:160]}) — "
+                        "rebuilding the backend"
+                    )
+                    heartbeat()
+                    rdzv.reset_backend()
+                    # the stuck global-map entries evict when the dead
+                    # ops' threads unwind — observed within ~10s; back off
+                    # long enough to land past that instead of burning
+                    # attempts inside the window
+                    time.sleep(1.0 * (attempt + 1))
+            if rebuild_err is not None:
+                self._mh_rdzv_failed(
+                    rdzv.RendezvousError(
+                        "world rebuild", f"never settled: {rebuild_err!r}"
+                    ),
+                    epoch,
+                )
+            self._build_aot_service()
+            # 8. controller seeding: sidecar vectors when the checkpoint was
+            # the source (identical bytes on every process), else the
+            # replicated snapshot — restricted to survivors / mean-filled
+            # for joiners, shares renormalized
+            if (
+                ctl
+                and "shares" in ctl
+                and ctl.get("active_ranks") is not None
+                and len(ctl["shares"]) == len(ctl["active_ranks"])
+            ):
+                self._adopt_controller_vectors(
+                    ctl["active_ranks"], ctl["shares"],
+                    ctl.get("node_times", ctl["shares"]),
+                )
+            else:
+                self._adopt_controller_vectors(
+                    prev_active, src["shares"], src["node_times"], src["cost"]
+                )
+            for p in joining:
+                for r in self._ranks_of_proc(p):
+                    self.health.readmit(r)
+            jax.block_until_ready(self.state.params)
+            heartbeat()  # survivor world answered — the new mesh is live
+            # a rejoined (or previously fired-on) peer needs a fresh watch
+            if joining and getattr(self, "_peer_watch", None) is not None:
+                self._peer_watch([f"proc{p}" for p in joining])
+            self._recoveries += 1
+            self._detect_t0 = None
+            dt = time.perf_counter() - t0
+            ev = {
+                "epoch": int(epoch),
+                "world_size": int(self.world_size),
+                "rdzv_gen": int(agreement.gen),
+                "roster": [int(p) for p in roster],
+                "detect_to_resume_s": round(dt, 4),
+                "restored_from": restored_from,
+            }
+            if lost:
+                ev["lost"] = [int(r) for r in lost]
+            if joining:
+                ev["readmitted"] = [
+                    int(r) for p in joining for r in self._ranks_of_proc(p)
+                ]
+            self._elastic_events.append(ev)
+            self.recorder.meta["elastic_events"] = self._elastic_events
+            self.logger.info(
+                f"elastic: re-rendezvous g{agreement.gen} complete — "
+                f"{self.world_size} workers over {self.n_proc} process(es) "
+                f"{roster}, state from {restored_from}, {dt:.3f}s detection "
+                "to resumed training"
+            )
+
+    def _attribute_collective_failure(
+        self, e: Exception, epoch: int
+    ) -> Optional[List[int]]:
+        """A mid-epoch exception on the multi-host elastic tier is usually
+        the COLLECTIVE dying with a peer (the gloo/XLA surface errors on the
+        closed socket long before the beacon goes stale). Hold the epoch for
+        up to the staleness window and let the beacon/claim verdict decide:
+        returns the lost ranks to recover over, or None to re-raise (a real
+        error, not a fleet change)."""
+        if self.cfg.elastic != "on" or self.n_proc == 1 or self._rdzv is None:
+            return None
+        if self._detect_t0 is None:
+            self._detect_t0 = time.perf_counter()
+        stale_s = float(os.environ.get("DBS_PEER_HB_STALE_S", "10.0"))
+        self.logger.warning(
+            f"elastic: epoch {epoch} dispatch failed ({e!r}) — waiting up "
+            f"to {stale_s + 3.0:.0f}s for a peer-liveness verdict before "
+            "treating it as fatal"
+        )
+        deadline = time.monotonic() + stale_s + 3.0
+        while time.monotonic() < deadline:
+            down = self._scan_peer_heartbeats(force=True)
+            lost = sorted(r for r in self.active_ranks if r in down)
+            if lost:
+                return lost
+            heartbeat()  # the wait is deliberate, not a stall
+            time.sleep(0.25)
+        return None
+
     def _maybe_readmit(self, epoch: int) -> None:
         """Epoch-boundary readmission: workers whose rejoin boundary is
         ``epoch`` (injector schedule) or that resumed signalling (health
@@ -2106,10 +2862,28 @@ class Trainer:
         cfg = self.cfg
         if cfg.elastic != "on" or cfg.elastic_readmit != "epoch":
             return
+        if self._rdzv is not None and self._n_proc0 > 1:
+            # multi-host growth is process-granular: a (re)spawned process
+            # offers a join file and the whole fleet re-rendezvouses. Keyed
+            # by the ORIGINAL fleet shape — a world shrunk to one surviving
+            # process still regrows through the rendezvous channel, never
+            # through local virtual-worker readmission
+            self._maybe_regrow_multihost(epoch)
+            return
         rejoin: set = set(self.health.recovering())
         rejoining = getattr(self.injector, "rejoining", None)
         if rejoining is not None:
             rejoin |= set(rejoining(epoch))
+        if self._n_proc0 > 1:
+            # a multi-host fleet that SHRANK to one process still owns only
+            # its own worker slice: a dead PEER's ranks must re-enter via a
+            # process rejoin (join file + grow rendezvous), never as local
+            # virtual workers — the post-shrink peer scan is empty, so
+            # filter by original-process ownership explicitly
+            rejoin = {
+                r for r in rejoin
+                if self._proc_of_rank(r) in set(self._proc_roster)
+            }
         # re-check liveness AT the boundary: a candidate can have gone down
         # again since it flipped RECOVERING (chance-mode injectors schedule
         # overlapping outages) — readmitting a down worker burns a full
@@ -2155,6 +2929,7 @@ class Trainer:
                 desc="state re-placement",
                 tick=heartbeat,
             )
+            self._fix_comm_residual()
             jax.block_until_ready(self.state.params)
             heartbeat()  # readmitted mesh answered
             # carry survivors' cost anchors to their new compact slots;

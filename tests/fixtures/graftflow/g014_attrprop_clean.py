@@ -1,0 +1,68 @@
+"""Clean twin of g014_attrprop_violation: every attribute-valued axis
+spelling resolves — a literal-returning property (axis the mesh defines), a
+chained property, and the live-mesh ``axis_names`` derivation
+(mesh_batch_axes-style: whatever it returns names axes the mesh actually
+defines, so there is no unmet demand). All quiet."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def build_mesh(devices):
+    return Mesh(np.array(devices), ("data",))
+
+
+def mesh_batch_axes(mesh):
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
+
+
+class LiteralSteps:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    @property
+    def _axis_arg(self):
+        return "data"  # literal: joins the universe checks
+
+    @property
+    def _batch_entry(self):
+        return self._axis_arg  # property chaining resolves through it
+
+    def combine(self, grads):
+        return jax.lax.psum(grads, self._axis_arg)
+
+    def combine_chained(self, grads):
+        return jax.lax.psum(grads, self._batch_entry)
+
+
+class MeshDerivedSteps:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    @property
+    def _axis_arg(self):
+        # helper form: the value derives from the mesh's own axis_names
+        return mesh_batch_axes(self.mesh)
+
+    @property
+    def _axis_arg_inline(self):
+        # direct form of the same derivation
+        names = tuple(self.mesh.axis_names)
+        return names[0] if len(names) == 1 else names
+
+    def combine(self, grads):
+        return jax.lax.psum(grads, self._axis_arg)
+
+    def combine_inline(self, grads):
+        return jax.lax.psum(grads, self._axis_arg_inline)
+
+
+def run(devices, grads):
+    mesh = build_mesh(devices)
+    a = LiteralSteps(mesh)
+    b = MeshDerivedSteps(mesh)
+    g = jnp.asarray(grads)
+    return a.combine(g), a.combine_chained(g), b.combine(g), b.combine_inline(g)

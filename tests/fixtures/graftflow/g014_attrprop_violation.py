@@ -1,0 +1,72 @@
+"""Seeded G014, attribute-valued axis spellings (ISSUE 14 satellite — the
+recorded PR-13 residual gap): a collective whose axis argument is a live
+``self.<attr>`` property.
+
+Two bug classes:
+
+* ``_axis_arg`` returns an OPAQUE computed value — no resolution channel
+  grounds it, which used to err quiet; now it is an explicit "unresolved
+  axis expression" finding.
+* ``_typo_axis`` RESOLVES (a literal-returning property) to an axis no mesh
+  in the program defines — the ordinary unknown-axis finding, reachable
+  through the new property channel.
+* ``_masked_axis`` reads ``axis_names`` for an UNRELATED value and returns
+  an opaque attribute — the consistency-by-construction fallback must key
+  on the RETURNED value's derivation, not on any read in the body, or this
+  errs quiet again.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def build_mesh(devices):
+    return Mesh(np.array(devices), ("data",))
+
+
+class OpaqueSteps:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    @property
+    def _axis_arg(self):
+        # opaque: a computed string no static channel can ground
+        return "".join(["da", "ta"])
+
+    @property
+    def _typo_axis(self):
+        # resolves to a literal — but "dat" is defined by no mesh
+        return "dat"
+
+    @property
+    def _masked_axis(self):
+        # the axis_names read feeds an unrelated value; the RETURN is
+        # opaque — must still be an unresolved-axis-expression finding
+        n = len(self.mesh.axis_names)
+        self._n_axes = n
+        return self._dynamic_expr
+
+    def combine(self, grads):
+        # G014: unresolved axis expression (the property is opaque)
+        return jax.lax.psum(grads, self._axis_arg)
+
+    def combine_typo(self, grads):
+        # G014: resolved through the property to an axis no mesh defines
+        return jax.lax.psum(grads, self._typo_axis)
+
+    def combine_masked(self, grads):
+        # G014: the unrelated axis_names read must not silence this
+        return jax.lax.psum(grads, self._masked_axis)
+
+
+def run(devices, grads):
+    mesh = build_mesh(devices)
+    steps = OpaqueSteps(mesh)
+    steps._dynamic_expr = "".join(["da", "ta"])
+    return (
+        steps.combine(jnp.asarray(grads)),
+        steps.combine_typo(jnp.asarray(grads)),
+        steps.combine_masked(jnp.asarray(grads)),
+    )

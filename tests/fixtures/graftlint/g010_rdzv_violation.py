@@ -1,0 +1,49 @@
+"""Seeded G010 violations, rendezvous flavor (ISSUE 14): blocking
+coordination-service edges in a re-rendezvous scope with no heartbeat/tick
+coverage and no retry/timeout armor.
+
+The rendezvous runs exactly while the fleet is broken — an unarmored
+``jax.distributed.initialize`` (or client connect / barrier wait) against a
+wedged peer hangs the recovery itself, and the stall watchdog then reads the
+recovery as the hang it exists to abort.
+"""
+
+import jax
+
+from dynamic_load_balance_distributeddnn_tpu.runtime.health import (
+    retry_transient,
+)
+
+
+class MiniRendezvous:
+    def __init__(self, address, client):
+        self.address = address
+        self.client = client
+
+    def _rendezvous_reinit(self, num, rank):
+        # G010: a blocking world bring-up in a rendezvous scope — no tick,
+        # no retry armor; a dead coordinator hangs this forever
+        jax.distributed.initialize(
+            coordinator_address=self.address,
+            num_processes=num,
+            process_id=rank,
+        )
+
+    def _establish_connect(self):
+        # G010: bare client connect in an establish scope
+        self.client.connect()
+
+    def _agree_barrier(self, key):
+        # G010: a coordination-service barrier wait a dead peer never answers
+        self.client.wait_at_barrier(key, timeout_in_ms=10_000)
+
+    def _rendezvous_guarded(self, num, rank, tick):
+        # quiet: armored by retry_transient (bounded backoff + tick)
+        retry_transient(
+            lambda: jax.distributed.initialize(
+                coordinator_address=self.address,
+                num_processes=num,
+                process_id=rank,
+            ),
+            tick=tick,
+        )

@@ -479,3 +479,77 @@ def test_preemption_injector_real_kill_delivery():
     sent = inj.deliver(0.5)
     assert sent == [(0, "SIGKILL")]
     assert proc.wait(timeout=10) == -signal.SIGKILL
+
+
+def test_reset_rendezvous_dir_clears_stale_protocol_files(tmp_path):
+    """ISSUE 14 review hardening: a reused heartbeat dir (abort-and-resume
+    restarts the fleet in place) must not let the dead run's newest ack
+    win generation adoption — its generation's stale loss claims would
+    mark freshly restarted peers down at the first boundary. The gen-0
+    coordinator wipes protocol files; beacons and harness markers stay."""
+    from dynamic_load_balance_distributeddnn_tpu.runtime.rendezvous import (
+        RendezvousStateMachine,
+        reset_rendezvous_dir,
+    )
+
+    stale = [
+        "ack_g2.json",
+        "loss_g2_p0.json",
+        "propose_g3_r0_p1.json",
+        "torn_g2_p0",
+        "done_p1",
+        "join_p1.json",
+    ]
+    keep = ["proc0.hb", "epoch1_p0.marker"]
+    for name in stale + keep:
+        (tmp_path / name).write_text("{}")
+    assert reset_rendezvous_dir(str(tmp_path)) == len(stale)
+    assert sorted(p.name for p in tmp_path.iterdir()) == sorted(keep)
+    # a state machine arming afterwards starts at generation 0 again
+    sm = RendezvousStateMachine(str(tmp_path), ident=0)
+    assert sm.current_roster() == []
+    assert sm.gen == 0
+
+
+def test_preemption_injector_kill_respawn_roundtrip():
+    """ISSUE 14 satellite: a SIGKILLed PROCESS cannot SIGCONT back — a
+    "kill" event's rejoin edge fires the attached respawn callable instead
+    (once, idempotent per edge), and the returned pid re-attaches the
+    worker for any later scheduled signals."""
+    proc = subprocess.Popen([sys.executable, "-c", _SLEEPER])
+    proc2 = None
+    spawned = []
+    try:
+        inj = PreemptionInjector(
+            2,
+            [PreemptionEvent(worker=1, down_at=1.0, rejoin_epoch=3, kind="kill")],
+        )
+        inj.attach_process(1, proc.pid)
+
+        def spawn():
+            nonlocal proc2
+            proc2 = subprocess.Popen([sys.executable, "-c", _SLEEPER])
+            spawned.append(proc2.pid)
+            return proc2
+
+        inj.attach_respawn(1, spawn)
+        assert inj.deliver(1.5) == [(1, "SIGKILL")]
+        assert proc.wait(timeout=10) == -signal.SIGKILL
+        assert inj.deliver(2.0) == []  # rejoin edge not reached yet
+        assert spawned == []
+        assert inj.deliver(3.2) == [(1, "RESPAWN")]
+        assert len(spawned) == 1
+        assert proc2.poll() is None  # really running
+        # idempotent: re-polling the same edge never double-spawns
+        assert inj.deliver(3.5) == []
+        assert len(spawned) == 1
+        # the new pid is attached — a later schedule can signal it
+        assert inj._pids[1] == proc2.pid
+    finally:
+        for p in (proc, proc2):
+            if p is not None:
+                try:
+                    p.kill()
+                    p.wait(timeout=10)
+                except (OSError, ProcessLookupError):
+                    pass

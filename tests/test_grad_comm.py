@@ -229,6 +229,54 @@ def test_hier_elastic_combine_twins(bundle):
     assert float(np.abs(np.asarray(tr.state.comm_residual)).max()) > 0.0
 
 
+def test_hier_elastic_reshard_refactors_or_falls_back(bundle):
+    """ISSUE 14 satellite (the PR 12/13 open half): an elastic re-shard
+    RE-FACTORS the survivors into host groups — losing a whole block-pair
+    keeps ``--grad_comm hier`` on the reduced fleet, while a survivor
+    count that no longer factors into equal contiguous blocks falls back
+    to the flat combine with a re-keyed ``_comm_sig``."""
+    from dynamic_load_balance_distributeddnn_tpu.faults import (
+        PreemptionEvent,
+        PreemptionInjector,
+    )
+
+    # 8 devices / hier_hosts=2. Losing workers 6+7 leaves 6 devices: still
+    # two equal contiguous blocks of 3 — hier survives the re-shard.
+    cfg = _cfg(
+        dynamic_batch_size=True,
+        grad_comm_wire="int8",
+        epoch_size=3,
+        elastic="on",
+    )
+    inj = PreemptionInjector(
+        8,
+        [
+            PreemptionEvent(worker=6, down_at=1.4, rejoin_epoch=None),
+            PreemptionEvent(worker=7, down_at=1.4, rejoin_epoch=None),
+        ],
+    )
+    tr = Trainer(cfg, bundle=bundle, injector=inj, log_to_file=False)
+    rec = tr.run()
+    ev = next(e for e in rec.meta["elastic_events"] if "lost" in e)
+    assert sorted(ev["lost"]) == [6, 7]
+    assert tr.world_size == 6
+    assert tr.grad_comm == "hier" and tr._hier_hosts == 2
+    sig_hier = tr._comm_sig
+    assert np.isfinite(rec.data["train_loss"]).all()
+
+    # Losing ONE worker leaves 7 devices: 7 % 2 != 0 — no factorization,
+    # the re-shard logs the fallback and re-keys the combine signature.
+    inj2 = PreemptionInjector(
+        8, [PreemptionEvent(worker=7, down_at=1.4, rejoin_epoch=None)]
+    )
+    tr2 = Trainer(cfg, bundle=bundle, injector=inj2, log_to_file=False)
+    rec2 = tr2.run()
+    assert tr2.world_size == 7
+    assert tr2.grad_comm == "flat" and tr2._hier_hosts == 0
+    assert tr2._comm_sig != sig_hier  # stale hier executables can't resolve
+    assert np.isfinite(rec2.data["train_loss"]).all()
+
+
 # -------------------------------------------------- error-feedback residual
 
 
@@ -323,8 +371,10 @@ def test_config_guards():
     ).shard_update
     with pytest.raises(ValueError):
         Config(grad_comm="hier", compress_grads="int8", fused_dbs=True)
-    with pytest.raises(ValueError):
-        Config(grad_comm="hier", elastic="on")
+    # hier x elastic composes since ISSUE 14: _reshard_world re-factors the
+    # survivors into host groups (falling back to flat when they no longer
+    # form equal contiguous blocks)
+    assert Config(grad_comm="hier", elastic="on").elastic == "on"
     with pytest.raises(ValueError):
         Config(grad_comm_wire="int2")
     with pytest.raises(ValueError):

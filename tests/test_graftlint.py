@@ -39,6 +39,8 @@ def codes(findings):
         ("g008_violation.py", "G008", 2),  # recorded series + meta write
         ("g009_violation.py", "G009", 4),  # steps + jit dispatch, lower, compile
         ("g010_violation.py", "G010", 3),  # device_put + block + compile
+        # rendezvous scopes (ISSUE 14): distributed init + connect + barrier
+        ("g010_rdzv_violation.py", "G010", 3),
     ],
 )
 def test_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -259,6 +261,38 @@ def test_g009_warm_and_probe_scopes_are_quiet():
         "        return self.steps.worker_step_first(state, xb, yb)\n"
     )
     assert lint_source(src) == []
+
+
+def test_g010_tick_counts_as_coverage():
+    """The rendezvous state machine pulses through an injected ``tick``
+    (wired to watchdog.heartbeat) — a scope that ticks is covered, the same
+    scope without the tick trips."""
+    src = (
+        "import jax\n"
+        "from dynamic_load_balance_distributeddnn_tpu.runtime.health"
+        " import retry_transient\n"
+        "class SM:\n"
+        "    def __init__(self, client, tick):\n"
+        "        self.client = client\n"
+        "        self.tick = tick\n"
+        "    def _rdzv_connect(self):\n"
+        "        self.tick()\n"
+        "        self.client.connect()\n"
+    )
+    assert lint_source(src) == []
+    untick = src.replace("        self.tick()\n", "")
+    assert codes(lint_source(untick)) == {"G010"}
+
+
+def test_g010_shipped_rendezvous_module_is_armored():
+    """The shipped state machine is the clean reference implementation:
+    every blocking phase (gen-0 bring-up, teardown barrier, service ack,
+    connect) carries retry_transient armor or tick coverage."""
+    from dynamic_load_balance_distributeddnn_tpu.runtime import rendezvous
+
+    findings = lint_file(rendezvous.__file__)
+    assert [f for f in findings if f.code == "G010"] == [
+    ], [f.format() for f in findings]
 
 
 # ------------------------------------------------------------ rule mechanics

@@ -72,6 +72,12 @@ def model_of(src: str, path: str = "m.py") -> MeshModel:
         # plan taint through dict-VALUE iteration (.values() / .items()
         # tuple targets) — the last recorded modeling gap (PR-13 satellite)
         ("g016_dictval_violation.py", "G016", 2),
+        # ATTRIBUTE-valued axis spellings (ISSUE 14 satellite): an opaque
+        # self._axis_arg property is an explicit "unresolved axis
+        # expression" finding, a literal-returning property feeds the
+        # ordinary unknown-axis check, and an UNRELATED axis_names read in
+        # the body must not silence an opaque return (review hardening)
+        ("g014_attrprop_violation.py", "G014", 3),
     ],
 )
 def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -95,6 +101,7 @@ def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings)
         "g015_key_clean.py",
         "g014_tuplevar_clean.py",
         "g016_dictval_clean.py",
+        "g014_attrprop_clean.py",
     ],
 )
 def test_clean_fixture_is_quiet(fixture):
@@ -157,6 +164,57 @@ def test_axis_tuple_variable_resolves_through_local_bind():
     assert model.required_axes["m::strvar"] == {"host"}
     assert model.required_axes["m::opaque"] == set()
     assert model.required_axes["m::rebound"] == set()  # rebind forgets
+
+
+def test_attr_axis_property_resolution_channels():
+    """ISSUE 14 satellite: ``self.<attr>`` collective-axis spellings
+    resolve through simple property returns — a literal joins the demand,
+    a chained property resolves through its target, a live-mesh
+    ``axis_names`` derivation contributes no demand (consistent by
+    construction), and an opaque property lands in
+    ``unresolved_axis_sites`` instead of erring quiet."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        "    return Mesh(np.array(devices), ('data',))\n"
+        "def batch_axes(mesh):\n"
+        "    names = tuple(mesh.axis_names)\n"
+        "    return names[0] if len(names) == 1 else names\n"
+        "class Steps:\n"
+        "    def __init__(self, mesh):\n"
+        "        self.mesh = mesh\n"
+        "    @property\n"
+        "    def lit(self):\n"
+        "        return 'data'\n"
+        "    @property\n"
+        "    def chained(self):\n"
+        "        return self.lit\n"
+        "    @property\n"
+        "    def derived(self):\n"
+        "        return batch_axes(self.mesh)\n"
+        "    @property\n"
+        "    def opaque(self):\n"
+        "        return ''.join(['da', 'ta'])\n"
+        "    def c_lit(self, x):\n"
+        "        return jax.lax.psum(x, self.lit)\n"
+        "    def c_chained(self, x):\n"
+        "        return jax.lax.psum(x, self.chained)\n"
+        "    def c_derived(self, x):\n"
+        "        return jax.lax.psum(x, self.derived)\n"
+        "    def c_opaque(self, x):\n"
+        "        return jax.lax.psum(x, self.opaque)\n"
+    )
+    model = model_of(src)
+    assert model.required_axes["m::Steps.c_lit"] == {"data"}
+    assert model.required_axes["m::Steps.c_chained"] == {"data"}
+    assert model.required_axes["m::Steps.c_derived"] == set()
+    assert model.required_axes["m::Steps.c_opaque"] == set()
+    sites = [
+        (fqn, tok) for fqn, _l, _c, _t, tok in model.unresolved_axis_sites
+    ]
+    assert sites == [("m::Steps.c_opaque", "self.opaque")]
 
 
 def test_two_level_axis_universe_and_tuple_collectives():

@@ -120,6 +120,266 @@ def test_two_process_training():
     assert abs(shares.sum() - 1.0) < 1e-5
 
 
+def _spawn_rdzv_workers(tmp_path, n, port, env_extra=None, epochs=3, ws=4):
+    """Launch ``n`` DBS_MH_RDZV workers logging to ``tmp_path/p<i>.log``.
+    Returns (procs, log_paths, env)."""
+    hb = tmp_path / "hb"
+    ck = tmp_path / "ck"
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(
+        DBS_MH_RDZV="1",
+        DBS_PEER_HB_DIR=str(hb),
+        DBS_MH_CKPT=str(ck),
+        DBS_MH_EPOCHS=str(epochs),
+        DBS_MH_WS=str(ws),
+        DBS_PEER_HB_PERIOD_S="0.2",
+        DBS_PEER_HB_STALE_S="2.0",
+        DBS_RDZV_TIMEOUT_S="60",
+    )
+    env.update(env_extra or {})
+    procs, logs = [], []
+    for i in range(n):
+        lp = tmp_path / f"p{i}.log"
+        logs.append(lp)
+        with open(lp, "w") as lf:
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, _WORKER, str(i), str(n), str(port)],
+                    stdout=lf,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                    cwd=_REPO,
+                )
+            )
+    return procs, logs, env
+
+
+def _wait_for(path, procs, deadline_s=300, desc="marker"):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline and not os.path.exists(str(path)):
+        if all(p.poll() is not None for p in procs):
+            return False
+        time.sleep(0.1)
+    return os.path.exists(str(path))
+
+
+def _result_of(log_path):
+    out = open(str(log_path)).read()
+    lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+    assert lines, f"no RESULT line in {log_path}:\n{out[-4000:]}"
+    return json.loads(lines[-1][len("RESULT "):])
+
+
+def _kill_all(procs):
+    for p in procs:
+        try:
+            p.kill()
+            p.wait(timeout=30)
+        except (OSError, ProcessLookupError):
+            pass
+
+
+def test_mh_kill_rerendezvous_resume_bitwise(tmp_path):
+    """ISSUE 14 tentpole: a real 2-process run SURVIVES SIGKILL of one
+    peer — the survivor detects the loss (collective-failure attribution +
+    the watcher's detection marker), re-rendezvouses over the survivor
+    set at the epoch boundary, restores the flushed checkpoint onto the
+    reduced mesh and resumes with zero steady-state foreground compiles;
+    the resumed trajectory is BITWISE-identical to a fresh reduced-world
+    run restored from the same checkpoint."""
+    port = _free_port()
+    procs, logs, env = _spawn_rdzv_workers(tmp_path, 2, port, epochs=3)
+    hb = tmp_path / "hb"
+    try:
+        assert _wait_for(
+            hb / "epoch1_p1.marker", procs
+        ), "fleet never reached epoch 1"
+        procs[1].send_signal(signal.SIGKILL)
+        rc0 = procs[0].wait(timeout=300)
+        rc1 = procs[1].wait(timeout=30)
+    finally:
+        _kill_all(procs)
+    assert rc1 == -signal.SIGKILL  # the kill was real
+    out0 = open(str(logs[0])).read()
+    assert rc0 == 0, f"survivor failed:\n{out0[-4000:]}"
+    r = _result_of(logs[0])
+
+    # survivor world: 2 workers over 1 process, ranks [2,3] gone
+    assert r["world_size"] == 2 and r["n_proc"] == 1
+    assert r["roster"] == [0]
+    evs = r["elastic_events"]
+    assert len(evs) == 1, evs
+    ev = evs[0]
+    assert ev["lost"] == [2, 3]
+    assert ev["rdzv_gen"] == 1
+    assert ev["restored_from"] == "checkpoint[0]"
+    assert 0.0 < ev["detect_to_resume_s"] < 60.0
+    # all three epochs trained (epoch 1 re-ran after the recovery)
+    assert len(r["losses"]) == 3
+    # zero steady-state foreground compiles after the re-warm
+    assert r["xla_compiles"][-1] == 0
+    # the watcher thread's detection marker (diagnosis survives even when
+    # the collective's own failure was the first signal)
+    assert (hb / "elastic_detected_proc1_by_proc0.json").exists()
+
+    # ---- bitwise parity vs a fresh reduced-world run --------------------
+    # A checkpoint-0-only copy (the live dir's LATEST step is the final
+    # epoch — restoring it would be circular)
+    import shutil
+
+    ck, ckp = tmp_path / "ck", tmp_path / "ck_parity"
+    ckp.mkdir()
+    shutil.copytree(ck / "0", ckp / "0")
+    shutil.copy(ck / "controller_0.json", ckp / "controller_0.json")
+    penv = {
+        k: v
+        for k, v in env.items()
+        if k not in ("DBS_MH_RDZV", "DBS_PEER_HB_DIR")
+    }
+    # the survivor-restricted sidecar — exactly what the survivor's
+    # recovery adopts (_adopt_controller_vectors: survivor entries kept,
+    # shares renormalized, node_times as-is)
+    side = json.loads((ck / "controller_0.json").read_text())
+    sh = [side["shares"][r] for r in (0, 1)]
+    penv.update(
+        DBS_MH_PARITY="1",
+        DBS_MH_CKPT=str(ckp),
+        DBS_MH_PARITY_VECS=json.dumps(
+            {
+                "shares": [s / sum(sh) for s in sh],
+                "node_times": [side["node_times"][r] for r in (0, 1)],
+            }
+        ),
+    )
+    pp = subprocess.Popen(
+        [sys.executable, _WORKER, "0", "1", str(port)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=penv,
+        cwd=_REPO,
+    )
+    pout, _ = pp.communicate(timeout=600)
+    assert pp.returncode == 0, f"parity leg failed:\n{pout[-4000:]}"
+    pr = json.loads(
+        [ln for ln in pout.splitlines() if ln.startswith("RESULT ")][-1][
+            len("RESULT "):
+        ]
+    )
+    assert pr["start_epoch"] == 1  # resumed FROM checkpoint 0
+    # bitwise: identical parameter bytes and identical post-recovery loss rows
+    assert pr["params_hash"] == r["params_hash"]
+    assert pr["losses"] == r["losses"][1:]
+
+
+def test_mh_wedged_rendezvous_degrades_to_abort(tmp_path):
+    """A rendezvous that cannot complete must DEGRADE, not hang: proc 2 is
+    SIGKILLed, proc 1 is wedged (beacon alive, never reaches agree()) — the
+    healthy survivor's propose phase times out and it falls back to today's
+    abort-and-resume-from-checkpoint, logging the phase that died."""
+    port = _free_port()
+    procs, logs, _ = _spawn_rdzv_workers(
+        tmp_path,
+        3,
+        port,
+        epochs=3,
+        ws=3,  # one worker per process
+        env_extra={"DBS_MH_WEDGE": "1", "DBS_RDZV_TIMEOUT_S": "8"},
+    )
+    hb = tmp_path / "hb"
+    try:
+        assert _wait_for(
+            hb / "epoch1_p2.marker", procs
+        ), "fleet never reached epoch 1"
+        procs[2].send_signal(signal.SIGKILL)
+        t0 = time.time()
+        rc0 = procs[0].wait(timeout=240)
+        wall = time.time() - t0
+    finally:
+        _kill_all(procs)
+    out0 = open(str(logs[0])).read()
+    # nonzero abort, not a hang — and attributed to the rendezvous phase
+    assert rc0 == 17, f"rc={rc0}:\n{out0[-4000:]}"
+    assert wall < 200.0
+    assert "degrading to abort-and-resume" in out0, out0[-4000:]
+    assert "re-rendezvous FAILED in phase" in out0, out0[-4000:]
+
+
+def test_mh_kill_shrink_respawn_regrow(tmp_path):
+    """Satellite: the chaos round-trip — SIGKILL one peer (shrink), then
+    respawn it as a JOINER (``DBS_MH_RESPAWNED=1``): it offers a rendezvous
+    join, the survivor admits it at the next epoch boundary (grow), and
+    both processes finish the run over the restored 4-worker fleet with
+    IDENTICAL parameter bytes."""
+    port = _free_port()
+    procs, logs, env = _spawn_rdzv_workers(
+        tmp_path,
+        2,
+        port,
+        epochs=10,
+        # stretch epochs so the joiner (full interpreter + jax import)
+        # finds a boundary left to be admitted at
+        env_extra={"DBS_MH_EPOCH_SLEEP_S": "3"},
+    )
+    hb = tmp_path / "hb"
+    joiner = None
+    try:
+        assert _wait_for(
+            hb / "epoch1_p1.marker", procs
+        ), "fleet never reached epoch 1"
+        procs[1].send_signal(signal.SIGKILL)
+        # survivor reaches epoch 2 => the shrink rendezvous completed
+        assert _wait_for(
+            hb / "epoch2_p0.marker", [procs[0]]
+        ), "survivor never resumed after the kill"
+        jenv = dict(env)
+        jenv.update(DBS_MH_RESPAWNED="1", DBS_MH_IDENT="1")
+        jlog = tmp_path / "p1_respawn.log"
+        with open(jlog, "w") as jf:
+            joiner = subprocess.Popen(
+                [sys.executable, _WORKER, "1", "2", str(port)],
+                stdout=jf,
+                stderr=subprocess.STDOUT,
+                env=jenv,
+                cwd=_REPO,
+            )
+        rc0 = procs[0].wait(timeout=400)
+        rcj = joiner.wait(timeout=400)
+    finally:
+        _kill_all(procs + ([joiner] if joiner is not None else []))
+    out0 = open(str(logs[0])).read()
+    outj = open(str(jlog)).read()
+    assert rc0 == 0, f"survivor failed:\n{out0[-4000:]}"
+    assert rcj == 0, f"joiner failed:\n{outj[-4000:]}"
+    r0, rj = _result_of(logs[0]), _result_of(jlog)
+
+    # the grown world: 4 workers over both processes again, on BOTH sides
+    for r in (r0, rj):
+        assert r["world_size"] == 4 and r["n_proc"] == 2
+        assert r["roster"] == [0, 1]
+    # shrink then grow recorded on the survivor
+    kinds = [
+        ("lost" in ev, "readmitted" in ev) for ev in r0["elastic_events"]
+    ]
+    assert (True, False) in kinds and (False, True) in kinds, (
+        r0["elastic_events"]
+    )
+    grow = next(ev for ev in r0["elastic_events"] if "readmitted" in ev)
+    assert grow["readmitted"] == [2, 3]
+    # both processes trained to the same parameters, bit for bit, and the
+    # joiner's loss rows are the survivor's tail
+    assert rj["params_hash"] == r0["params_hash"]
+    assert rj["losses"] == r0["losses"][-len(rj["losses"]):]
+    # steady state after the grow epoch is compile-free on the survivor
+    grow_epoch = int(grow["epoch"])
+    assert all(c == 0 for c in r0["xla_compiles"][grow_epoch + 1:])
+
+
 def test_elastic_peer_loss_detection(tmp_path):
     """ISSUE 6 multi-host story: cross-process recovery is deliberately out
     of scope (a dead peer takes its mesh slice with it — README "Fault

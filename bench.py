@@ -54,6 +54,10 @@ init can block 50+ minutes and then fail UNAVAILABLE):
    reference epoch cadence (`online_dbs_ab` field: steady epoch walls,
    switch counts, controller ledger, realized injection; ISSUE 11,
    BENCH_ONLINE_AB=0 disables, BENCH_ONLINE_SCHEDULE/PERIOD/EPOCHS tune).
+10. FLIGHT RECORDER A/B — the CPU tier measures the crash-durable spool's
+   wall cost (`obs_overhead_ab`: --trace ring + --trace_spool vs trace-off
+   on the same elastic plan, budget <= 5%, spool bytes/step recorded;
+   ISSUE 15, BENCH_OBS_AB=0 disables).
 
 Instrumentation: examples/s and MFU (obs/flops.py, XLA cost model vs chip
 bf16 peak) from the trainer's recorder extras, reported in `detail`.
@@ -574,6 +578,83 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
                     3,
                 )
             out["instr"]["trace_overhead_ab"] = ab
+        _write_atomic(out_path, out)
+
+    if (
+        force_cpu
+        and os.environ.get("BENCH_OBS_AB", "1") == "1"
+        and "obs_overhead_ab" not in out["instr"]
+    ):
+        if resume.get("instr", {}).get("obs_overhead_ab"):
+            out["instr"]["obs_overhead_ab"] = resume["instr"]["obs_overhead_ab"]
+        else:
+            # Flight-recorder overhead A/B (ISSUE 15 acceptance): the SAME
+            # elastic DBS run traced AND spooled (--trace ring +
+            # --trace_spool, the crash-durable sink with its background
+            # flusher) vs trace-off. The budget: enabled overhead stays
+            # under a few percent of wall (the hot path adds ONE bounded-
+            # deque append per event; serialization and I/O live on the
+            # flusher thread). Also records spool bytes/step — the disk
+            # price of crash durability.
+            import shutil as _shutil
+
+            from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
+                configure as configure_tracer,
+            )
+
+            spool_dir = tempfile.mkdtemp(prefix="bench_obs_ab_")
+            ab = {
+                "note": (
+                    "min over steady epochs per leg; delta is jitter-"
+                    "bounded, budget asserts <= 5%"
+                ),
+            }
+            n_ab = 4
+            try:
+                for label, mode in (("off", "off"), ("spooled", "ring")):
+                    cfg = Config(
+                        debug=False,
+                        world_size=ws,
+                        batch_size=batch,
+                        learning_rate=0.01,
+                        epoch_size=n_ab,
+                        dataset=dataset,
+                        model=model,
+                        dynamic_batch_size=True,
+                        fault_tolerance=False,
+                        bucket=bucket,
+                        precision=precision,
+                        trace=mode,
+                        trace_spool=spool_dir if mode != "off" else "",
+                        trace_spool_flush_s=0.1,
+                    )
+                    tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+                    walls = [
+                        tr.run_epoch(e)["epoch_wall"] for e in range(n_ab)
+                    ]
+                    ab[f"{label}_wall_s"] = round(min(walls[1:]), 6)
+                    if mode != "off":
+                        ab["trace_events"] = tr._trace.event_count()
+                        sp = tr.close_spool()
+                        steps = n_ab * max(
+                            -(-len(bundle.train_x) // batch), 1
+                        )
+                        if sp is not None:
+                            ab["spool_bytes"] = int(sp.bytes_written)
+                            ab["spool_bytes_per_step"] = round(
+                                sp.bytes_written / steps, 1
+                            )
+                    # process-global tracer: later legs must run untraced
+                    configure_tracer("off")
+            finally:
+                _shutil.rmtree(spool_dir, ignore_errors=True)
+            if ab.get("off_wall_s") and ab.get("spooled_wall_s"):
+                frac = (
+                    ab["spooled_wall_s"] - ab["off_wall_s"]
+                ) / ab["off_wall_s"]
+                ab["overhead_pct"] = round(100.0 * frac, 3)
+                ab["within_budget"] = bool(frac <= 0.05)
+            out["instr"]["obs_overhead_ab"] = ab
         _write_atomic(out_path, out)
 
     if (

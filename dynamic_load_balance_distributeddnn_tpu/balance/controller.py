@@ -52,6 +52,7 @@ a foreground XLA compile — the zero-foreground-compile sentinel contract
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -60,6 +61,12 @@ from dynamic_load_balance_distributeddnn_tpu.balance.solver import (
     quantize_batches,
     rebalance,
 )
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
+
+# decision-journal ring cap: one entry per controller evaluation; a week-long
+# run at window cadence stays bounded, and the postmortem question ("why did
+# epoch 7 rebalance?") only ever needs the recent tail
+JOURNAL_CAP = 4096
 
 
 @dataclasses.dataclass
@@ -136,6 +143,12 @@ class OnlineRebalanceController:
         self.last_candidate_batches: Optional[np.ndarray] = None
         self.events: List[Dict] = []
         self.on_switch = None  # test/observability hook: fn(event_dict)
+        # decision journal (ISSUE 15): EVERY evaluation's verdict — hold or
+        # switch — with the inputs it was decided on, so "why did epoch 7
+        # rebalance?" (and "why did it NOT?") is answerable offline. Ring-
+        # bounded; mirrored as graftscope ``decision`` instants when tracing
+        # is enabled and surfaced by `graftscope decisions`.
+        self.journal: deque = deque(maxlen=JOURNAL_CAP)
 
     # ------------------------------------------------------------- signal
 
@@ -176,6 +189,59 @@ class OnlineRebalanceController:
     def cost_estimate(self) -> float:
         return self.switch_cost_s if self.switch_cost_s is not None else self.cost_init
 
+    def _record_decision(
+        self,
+        dec: SwitchDecision,
+        eff_rates: Optional[np.ndarray] = None,
+        cur_batches: Optional[np.ndarray] = None,
+    ) -> SwitchDecision:
+        """Journal one evaluation: verdict + the inputs it was decided on
+        (EMA rates, modeled walls, regret ledgers, hysteresis state). Also
+        emitted as a graftscope ``decision`` instant so the flight
+        recorder's spool carries the journal through a crash."""
+        ev: Dict = {
+            "eval": int(self.evals),
+            "switch": bool(dec.switch),
+            "reason": dec.reason,
+            "predicted_win_s": round(float(dec.predicted_win_s), 6),
+            "cur_step_s": round(float(dec.cur_step_s), 6),
+            "new_step_s": round(float(dec.new_step_s), 6),
+            "cost_est_s": round(float(dec.cost_est_s), 6),
+            "remaining_steps": int(dec.remaining_steps),
+            "wall_scale": round(float(self.wall_scale), 4),
+            "hysteresis": self.hysteresis,
+            "margin": self.margin,
+            "budget_frac": self.budget_frac,
+            "spent_s": round(self.spent_s, 6),
+            "credit_s": round(self.credit_s, 6),
+            "switch_cost_ema_s": (
+                round(self.switch_cost_s, 6)
+                if self.switch_cost_s is not None
+                else None
+            ),
+        }
+        if eff_rates is not None:
+            ev["eff_rates"] = [round(float(r), 9) for r in eff_rates]
+        if cur_batches is not None:
+            ev["cur_batches"] = [int(b) for b in cur_batches]
+        if dec.candidate_batches is not None:
+            ev["candidate_batches"] = [int(b) for b in dec.candidate_batches]
+        if dec.candidate_shares is not None:
+            ev["candidate_shares"] = [
+                round(float(s), 6) for s in dec.candidate_shares
+            ]
+        self.journal.append(ev)
+        tracer = get_tracer()
+        if tracer.enabled:
+            # a COPY: commit/note_deferred annotate the journal entry later,
+            # and the trace must keep the verdict as decided
+            tracer.instant("dbs_decision", cat="decision", args=dict(ev))
+        return dec
+
+    def decision_journal(self) -> List[Dict]:
+        """The journal as a JSON-safe list (oldest first, ring-bounded)."""
+        return [dict(ev) for ev in self.journal]
+
     def propose(
         self,
         eff_rates: np.ndarray,
@@ -189,9 +255,9 @@ class OnlineRebalanceController:
         c = np.asarray(eff_rates, dtype=np.float64)
         b_cur = np.asarray(cur_batches, dtype=np.int64)
         if remaining_steps <= 0:
-            return SwitchDecision(False, "no-horizon")
+            return self._record_decision(SwitchDecision(False, "no-horizon"))
         if not np.isfinite(c).all() or (c <= 0).any():
-            return SwitchDecision(False, "no-signal")
+            return self._record_decision(SwitchDecision(False, "no-signal"))
         cur_shares = b_cur.astype(np.float64) / max(b_cur.sum(), 1)
         times = c * np.maximum(b_cur, 1)
         new_shares, batches = rebalance(
@@ -202,7 +268,9 @@ class OnlineRebalanceController:
             new_shares = batches.astype(np.float64) / batches.sum()
         self.last_candidate_batches = batches.copy()
         if np.array_equal(batches, b_cur):
-            return SwitchDecision(False, "same-plan", batches, new_shares)
+            return self._record_decision(
+                SwitchDecision(False, "same-plan", batches, new_shares), c, b_cur
+            )
         cur_step = step_time(c, b_cur, self.groups) * self.wall_scale
         new_step = step_time(c, batches, self.groups) * self.wall_scale
         win = (cur_step - new_step) * remaining_steps
@@ -220,16 +288,16 @@ class OnlineRebalanceController:
         )
         if win < self.hysteresis * cur_step * remaining_steps:
             dec.reason = "below-hysteresis"
-            return dec
+            return self._record_decision(dec, c, b_cur)
         if win < self.margin * cost:
             dec.reason = "below-margin"
-            return dec
+            return self._record_decision(dec, c, b_cur)
         if self.spent_s + cost > self.budget_frac * (self.credit_s + win):
             dec.reason = "budget-exhausted"
-            return dec
+            return self._record_decision(dec, c, b_cur)
         dec.switch = True
         dec.reason = "switch"
-        return dec
+        return self._record_decision(dec, c, b_cur)
 
     # --------------------------------------------------------- bookkeeping
 
@@ -261,6 +329,17 @@ class OnlineRebalanceController:
         }
         ev.update(extra)
         self.events.append(ev)
+        if self.journal:
+            # annotate the evaluation that produced this switch with what
+            # actually happened (the engine may defer/veto between the two)
+            self.journal[-1]["outcome"] = "committed"
+            self.journal[-1]["measured_cost_s"] = round(float(measured_cost_s), 6)
+            for k in ("epoch", "window", "step"):
+                if k in extra:
+                    self.journal[-1][k] = extra[k]
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.instant("dbs_switch", cat="decision", args=dict(ev))
         if self.logger is not None:
             self.logger.info(
                 f"online-dbs: switched plan -> {ev['batches']} "
@@ -277,6 +356,13 @@ class OnlineRebalanceController:
         re-evaluates at the next cadence boundary, by which time the
         speculative submit issued alongside the verdict has usually landed."""
         self.deferred += 1
+        if self.journal:
+            self.journal[-1]["outcome"] = "deferred"
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.instant(
+                "dbs_deferred", cat="decision", args={"deferred": self.deferred}
+            )
 
     def snapshot(self) -> Dict:
         """JSON-safe controller observability (recorder meta / registry)."""
@@ -292,4 +378,6 @@ class OnlineRebalanceController:
                 else None
             ),
             "wall_scale": round(self.wall_scale, 4),
+            "decisions": len(self.journal),
+            "last_decision": dict(self.journal[-1]) if self.journal else None,
         }

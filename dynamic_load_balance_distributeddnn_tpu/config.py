@@ -408,6 +408,21 @@ class Config:
                                        # jax.profiler.TraceAnnotation so host
                                        # spans line up with device timelines
                                        # inside a --profile_dir trace
+    trace_spool: str = ""              # flight recorder (ISSUE 15): non-empty
+                                       # = directory for a crash-durable
+                                       # per-process spool file the tracer
+                                       # streams into via a background
+                                       # flusher (length-framed JSONL; a
+                                       # SIGKILL loses at most the last
+                                       # flush interval). Stitch the
+                                       # survivors' + victims' spools with
+                                       # `graftscope postmortem <dir>`.
+                                       # Requires trace != off.
+    trace_spool_flush_s: float = 0.25  # spool flush cadence (also flushes
+                                       # at the 512-event watermark)
+    trace_spool_fsync: bool = False    # fsync each spool flush: survives
+                                       # power loss, not just process death
+                                       # (costs flush latency)
     elastic: str = "off"               # "on"|"off": elastic world size
                                        # (ISSUE 6). on: a per-worker health
                                        # monitor (runtime/health.py) feeds
@@ -589,6 +604,15 @@ class Config:
             raise ValueError("trace must be 'on', 'off' or 'ring'")
         if self.trace_ring < 1:
             raise ValueError("trace_ring must be >= 1")
+        if self.trace_spool_flush_s <= 0:
+            raise ValueError("trace_spool_flush_s must be > 0")
+        if self.trace_spool and self.trace == "off":
+            # the flight recorder streams TRACER events — with tracing off
+            # it would silently record nothing for exactly the chaos run it
+            # was configured to protect
+            raise ValueError(
+                "trace_spool requires tracing: set --trace ring (or on)"
+            )
         if self.superstep_window < 1:
             raise ValueError("superstep_window must be >= 1")
         if self.aot_pool < 0:
@@ -807,6 +831,19 @@ def get_parser() -> argparse.ArgumentParser:
                    help="Bridge spans into jax.profiler.TraceAnnotation so "
                         "host phases line up with device timelines in a "
                         "--profile_dir trace.")
+    p.add_argument("--trace_spool", type=str, default=d.trace_spool,
+                   help="Flight recorder: directory for a crash-durable "
+                        "per-process trace spool (background flusher; a "
+                        "SIGKILL loses at most the last flush interval). "
+                        "Merge post-mortem with `graftscope postmortem`.")
+    p.add_argument("--trace_spool_flush_s", type=float,
+                   default=d.trace_spool_flush_s,
+                   help="Spool flush cadence in seconds (also flushes at "
+                        "the event watermark).")
+    p.add_argument("--trace_spool_fsync", type=str2bool,
+                   default=d.trace_spool_fsync,
+                   help="fsync each spool flush (power-loss durability at "
+                        "the cost of flush latency).")
     p.add_argument("--elastic", type=str, default=d.elastic,
                    choices=["on", "off"],
                    help="Elastic world size: survive confirmed worker loss "

@@ -39,6 +39,8 @@ from typing import Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
+
 
 def seeded_rngs(seed: int, n: int) -> List[random.Random]:
     """One independent seeded ``random.Random`` stream per worker (the
@@ -365,6 +367,15 @@ class PreemptionInjector(FaultInjector):
                         f"{ev.kind} at t={ev.down_at:.2f}, rejoin at "
                         f"epoch {ev.rejoin_epoch}"
                     )
+                get_tracer().instant(
+                    "fault_scheduled", cat="fault",
+                    args={
+                        "worker": ev.worker,
+                        "kind": ev.kind,
+                        "down_at": round(ev.down_at, 4),
+                        "rejoin_epoch": ev.rejoin_epoch,
+                    },
+                )
 
     def schedule(self) -> List[PreemptionEvent]:
         return list(self._events)
@@ -475,6 +486,21 @@ class PreemptionInjector(FaultInjector):
                     if isinstance(new_pid, int):
                         self._pids[ev.worker] = new_pid
                     sent.append((ev.worker, "RESPAWN"))
+        if sent:
+            # fleet-timeline instants (ISSUE 15): every REAL signal edge the
+            # chaos harness delivers lands on the flight recorder, so a
+            # postmortem shows the injection beside its consequences
+            tracer = get_tracer()
+            if tracer.enabled:
+                for worker, signame in sent:
+                    tracer.instant(
+                        "fault_deliver", cat="fault",
+                        args={
+                            "worker": int(worker),
+                            "signal": signame,
+                            "t": round(float(t), 4),
+                        },
+                    )
         return sent
 
 

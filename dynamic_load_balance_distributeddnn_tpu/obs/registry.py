@@ -36,11 +36,21 @@ def device_peak_memory() -> Dict:
     (TPU/GPU runtimes), one row per local device with ``bytes_in_use`` and
     ``peak_bytes_in_use``; CPU backends expose no per-device allocator, so
     the fallback reports the process's peak RSS (and tracemalloc's peak
-    when tracing is active) — a coarser but honest host-side ceiling."""
-    import jax
+    when tracing is active) — a coarser but honest host-side ceiling.
+
+    Mid-rendezvous safe: while the distributed runtime is torn down
+    (retire_runtime -> establish), ``jax.local_devices()`` can raise — a
+    snapshot taken then degrades to ``{"source": "unavailable"}`` instead of
+    propagating and killing the caller's whole snapshot."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception as e:  # noqa: BLE001 — torn-down runtime mid-rendezvous
+        return {"source": "unavailable", "error": str(e)[:200]}
 
     out: Dict = {"source": "memory_stats", "per_device": []}
-    for d in jax.local_devices():
+    for d in devices:
         try:
             stats = d.memory_stats()
         except Exception:  # noqa: BLE001 — backend without an allocator API
@@ -86,14 +96,18 @@ class MetricsRegistry:
         self.compile_tracker = None  # analysis.guards.CompileTracker
         self.aot_service = None  # runtime.compiler.AOTCompileService
         self.health = None  # runtime.health.WorkerHealth
+        self.controller = None  # balance.controller.OnlineRebalanceController
 
     def attach(self, **surfaces) -> "MetricsRegistry":
         """Register observability surfaces by their well-known slot name
-        (``host_meter``, ``compile_tracker``, ``aot_service``, ``health``).
-        Unknown names raise — a typo'd attach would silently hollow the
-        snapshot."""
+        (``host_meter``, ``compile_tracker``, ``aot_service``, ``health``,
+        ``controller``). Unknown names raise — a typo'd attach would
+        silently hollow the snapshot."""
         for name, obj in surfaces.items():
-            if name not in ("host_meter", "compile_tracker", "aot_service", "health"):
+            if name not in (
+                "host_meter", "compile_tracker", "aot_service", "health",
+                "controller",
+            ):
                 raise ValueError(f"unknown registry surface {name!r}")
             setattr(self, name, obj)
         return self
@@ -120,7 +134,9 @@ class MetricsRegistry:
             },
             "trace": {
                 "mode": self.tracer.mode,
-                "events": len(self.tracer.events()) if self.tracer.enabled else 0,
+                # O(1): events() would COPY the whole deque (up to 1M
+                # tuples) just to take a length
+                "events": self.tracer.event_count() if self.tracer.enabled else 0,
             },
         }
         # gradient-collective wire accounting (ISSUE 12): per-epoch bytes
@@ -162,4 +178,9 @@ class MetricsRegistry:
             }
         if self.health is not None:
             out["health"] = self.health.snapshot()
+        if self.controller is not None:
+            # the online-DBS decision journal's live surface (ISSUE 15):
+            # ledgers, decision count, and the most recent verdict with the
+            # inputs it was decided on
+            out["controller"] = self.controller.snapshot()
         return out

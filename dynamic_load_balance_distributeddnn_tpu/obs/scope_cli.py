@@ -9,12 +9,24 @@ Usage::
     graftscope diff before.trace.json after.trace.json    # phase deltas
     graftscope summarize run.trace.json --json            # machine-readable
     graftscope merge run.trace.json -o merged.json        # + worker traces
+    graftscope postmortem spools/                         # crash stitcher
+    graftscope decisions traces/run.trace.json            # DBS journal
 
 ``summarize`` and ``merge`` automatically stitch compile-worker trace files
 (``compile_worker_*.trace.json``, written per process by the AOT service's
 process backend — runtime/compile_worker.py) found next to the run trace,
 so compile walls attribute across processes as pid-tagged tracks
 (``--no-workers`` reads the run trace alone).
+
+``postmortem`` (ISSUE 15) is the flight-recorder reader: it merges every
+``*.spool`` file (crash-durable spools from ``--trace_spool``, torn tails
+tolerated) and any sibling ``*.trace.json`` in a directory into ONE
+pid-tagged Perfetto trace — survivors' rendezvous state-machine spans next
+to the victim's last spooled events, realigned by each file's unix-time
+base — and prints a textual incident report (detection → drain → rebuild
+per process). ``decisions`` renders the online-DBS controller's decision
+journal (every switch/hold verdict with the inputs it was decided on) from
+a trace or spool, so "why did epoch 7 rebalance?" is answerable offline.
 
 Exit status: 0 on success, 2 on usage/IO errors.
 """
@@ -52,15 +64,22 @@ def _worker_traces(path: str) -> List[str]:
     )
 
 
-def _load_stitched(path: str, with_workers: bool) -> "tuple[List[dict], List[str]]":
-    """(events, worker-trace provenance): stitches un-merged sibling worker
-    files in; provenance also includes files the engine already merged, so
-    the per-pid compile table renders for pre-stitched traces too."""
+def _load_stitched(
+    path: str, with_workers: bool
+) -> "tuple[List[dict], List[str], List[str]]":
+    """(events, worker-trace provenance, skipped): stitches un-merged
+    sibling worker files in; provenance also includes files the engine
+    already merged, so the per-pid compile table renders for pre-stitched
+    traces too. Torn/mid-write worker files land in ``skipped`` (the chaos
+    harness kills processes during save) instead of failing the load."""
     workers = _worker_traces(path) if with_workers else []
     stitched = (workers + merged_names(path)) if with_workers else []
+    skipped: List[str] = []
     if workers:
-        return merge_trace_events([path] + workers), stitched
-    return load_trace(path), stitched
+        events = merge_trace_events([path] + workers, skipped=skipped)
+        stitched = [w for w in stitched if os.path.basename(w) not in skipped]
+        return events, stitched, skipped
+    return load_trace(path), stitched, skipped
 
 
 def _compile_walls_by_pid(events: List[dict]) -> Dict[int, float]:
@@ -92,7 +111,7 @@ def summarize(
     as_json: bool = False,
     with_workers: bool = True,
 ) -> str:
-    events, workers = _load_stitched(path, with_workers)
+    events, workers, skipped = _load_stitched(path, with_workers)
     att = attribution(events)
     compile_walls = _compile_walls_by_pid(events) if workers else {}
     epochs = att["epochs"]
@@ -108,6 +127,8 @@ def summarize(
             payload["compile_wall_s_by_pid"] = {
                 str(k): round(v, 6) for k, v in sorted(compile_walls.items())
             }
+        if skipped:
+            payload["skipped_traces"] = skipped
         return json.dumps(payload)
     out = []
     for ep, info in sorted(epochs.items(), key=lambda kv: int(kv[0])):
@@ -152,6 +173,12 @@ def summarize(
                 ["pid", "compile s"],
             )
         )
+    if skipped:
+        out.append("")
+        out.append(
+            f"skipped {len(skipped)} unreadable (torn/mid-write) worker "
+            f"trace file(s): {', '.join(skipped)}"
+        )
     return "\n".join(out).rstrip()
 
 
@@ -185,6 +212,390 @@ def diff(path_a: str, path_b: str, as_json: bool = False) -> str:
     return _fmt_table(rows, ["phase", "A (s)", "B (s)", "delta", "B/A"])
 
 
+# ------------------------------------------------------------- postmortem
+
+
+def _is_postmortem_output(path: str) -> bool:
+    """Does this trace carry the postmortem stitcher's own metadata marker?
+    A previous run's output (under ANY -o name) must never be re-ingested
+    as a source — its trace-only tracks would double-count."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return isinstance(data, dict) and bool(
+        (data.get("graftscope") or {}).get("postmortem")
+    )
+
+
+def _gather_sources(
+    dir_or_file: str, exclude: "Optional[set]" = None
+) -> "tuple[List[Dict], List[str]]":
+    """Load every spool and trace under a directory (or the single file
+    given) into per-source dicts ``{"label", "pid", "ident", "base_unix",
+    "events", "truncated", "dropped", "kind"}``. Unreadable files are
+    skipped and reported, never fatal — this is the crash path.
+    ``exclude`` holds resolved paths to never ingest (the run's own output);
+    earlier postmortem outputs are recognized by their metadata marker."""
+    exclude = {os.path.abspath(p) for p in (exclude or ())}
+    if os.path.isdir(dir_or_file):
+        spools = sorted(glob.glob(os.path.join(dir_or_file, "*.spool")))
+        traces = sorted(
+            p
+            for p in glob.glob(os.path.join(dir_or_file, "*.trace.json"))
+            if os.path.abspath(p) not in exclude
+            and not _is_postmortem_output(p)
+        )
+    elif dir_or_file.endswith(".spool"):
+        spools, traces = [dir_or_file], []
+    else:
+        spools, traces = [], [dir_or_file]
+    from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
+        _load_trace_payload,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.obs.spool import (
+        spool_to_chrome,
+    )
+    sources: List[Dict] = []
+    skipped: List[str] = []
+    for path in spools:
+        label = os.path.basename(path)
+        try:
+            got = spool_to_chrome(path)
+        except (OSError, ValueError) as exc:
+            print(f"graftscope: skipping {label}: {exc}", file=sys.stderr)
+            skipped.append(label)
+            continue
+        got.update(label=label[: -len(".spool")], kind="spool")
+        sources.append(got)
+    spool_pids = {s["pid"] for s in sources}
+    for path in traces:
+        label = os.path.basename(path)
+        try:
+            events, base = _load_trace_payload(path)
+        except (OSError, ValueError) as exc:
+            print(f"graftscope: skipping {label}: {exc}", file=sys.stderr)
+            skipped.append(label)
+            continue
+        # a process's SPOOL is the canonical record: a run trace saved by
+        # the same pid (e.g. --trace_dir pointing into the spool dir, or a
+        # survivor's end-of-run save copied next to the spools) holds the
+        # same events and would double-count every span; keep only the
+        # tracks of pids with no spool (merged compile workers, etc.)
+        dup = {
+            e.get("pid")
+            for e in events
+            if e.get("pid") in spool_pids
+        }
+        if dup:
+            events = [e for e in events if e.get("pid") not in spool_pids]
+            print(
+                f"graftscope: {label}: dropping pid(s) "
+                f"{sorted(int(p) for p in dup)} already covered by a spool",
+                file=sys.stderr,
+            )
+            if not events:
+                continue
+        pids = sorted(
+            {e.get("pid") for e in events if e.get("pid") is not None}
+        )
+        sources.append(
+            {
+                "label": label[: -len(".trace.json")]
+                if label.endswith(".trace.json")
+                else label,
+                "kind": "trace",
+                "pid": pids[0] if pids else 0,
+                "ident": None,
+                "base_unix": base,
+                "events": events,
+                "truncated": False,
+                "dropped": 0,
+            }
+        )
+    return sources, skipped
+
+
+def _merge_sources(sources: List[Dict]) -> "tuple[List[dict], Optional[float]]":
+    """Shift every source's events into ONE timeline: the reference frame is
+    the EARLIEST ``base_unix`` (the first process to come up), the same
+    unix-twin realignment ``merge_trace_events`` uses. Sources with no base
+    stamp land unshifted (best effort beats dropped evidence)."""
+    bases = [s["base_unix"] for s in sources if s["base_unix"] is not None]
+    base0 = min(bases) if bases else None
+    out: List[dict] = []
+    for s in sources:
+        shift_us = 0.0
+        if base0 is not None and s["base_unix"] is not None:
+            shift_us = (s["base_unix"] - base0) * 1e6
+        named = {
+            e.get("pid")
+            for e in s["events"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        pids = {e.get("pid") for e in s["events"] if e.get("pid") is not None}
+        for pid in sorted(p for p in pids - named if p is not None):
+            out.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "args": {"name": s["label"]},
+                }
+            )
+        for ev in s["events"]:
+            if shift_us and "ts" in ev:
+                ev = dict(ev)
+                ev["ts"] = round(ev["ts"] + shift_us, 3)
+            out.append(ev)
+    return out, base0
+
+
+# span/instant categories that narrate an incident, in rough ladder order
+_INCIDENT_SPAN_CATS = ("recover", "rdzv")
+_INCIDENT_INSTANT_CATS = ("elastic", "rdzv", "fault", "health")
+
+
+def _incident_report(
+    sources: List[Dict], merged: List[dict], base0: Optional[float]
+) -> Dict:
+    """Structured incident report over the merged, realigned events: per
+    process, the last spooled evidence and the recovery spans (detection →
+    drain → rebuild); fleet-wide, the chronological instant-event
+    timeline."""
+
+    def _wall(ts_us: float) -> Optional[float]:
+        return None if base0 is None else round(base0 + ts_us / 1e6, 3)
+
+    procs: Dict[int, Dict] = {}
+    for s in sources:
+        procs.setdefault(int(s.get("pid") or 0), {}).update(
+            source=s["label"],
+            kind=s["kind"],
+            ident=s.get("ident"),
+            truncated=bool(s.get("truncated")),
+            dropped=int(s.get("dropped") or 0),
+        )
+    timeline: List[Dict] = []
+    for ev in merged:
+        pid = ev.get("pid", 0)
+        info = procs.setdefault(int(pid), {"source": str(pid), "kind": "?"})
+        if ev.get("ph") == "M":
+            continue
+        info["events"] = info.get("events", 0) + 1
+        ts = float(ev.get("ts", 0.0))
+        end = ts + float(ev.get("dur", 0.0))
+        if end >= info.get("last_ts", float("-inf")):
+            info["last_ts"] = end
+        tail = info.setdefault("_tail", [])
+        tail.append({"name": ev.get("name"), "ts_us": round(ts, 1)})
+        if len(tail) > 8:
+            del tail[0]
+        if ev.get("ph") == "X" and ev.get("cat") in _INCIDENT_SPAN_CATS:
+            info.setdefault("recovery_spans", []).append(
+                {
+                    "name": ev.get("name"),
+                    "start_s": round(ts / 1e6, 4),
+                    "dur_s": round(float(ev.get("dur", 0.0)) / 1e6, 4),
+                    "wall_unix": _wall(ts),
+                }
+            )
+        if ev.get("ph") == "i" and ev.get("cat") in _INCIDENT_INSTANT_CATS:
+            timeline.append(
+                {
+                    "ts_us": round(ts, 1),
+                    "wall_unix": _wall(ts),
+                    "pid": pid,
+                    "cat": ev.get("cat"),
+                    "name": ev.get("name"),
+                    "args": ev.get("args") or {},
+                }
+            )
+    timeline.sort(key=lambda e: e["ts_us"])
+    decisions = sum(
+        1
+        for ev in merged
+        if ev.get("ph") == "i" and ev.get("cat") == "decision"
+    )
+    for info in procs.values():
+        info["last_events"] = info.pop("_tail", [])
+        if "last_ts" in info:
+            info["last_seen_unix"] = _wall(info.pop("last_ts"))
+        if "recovery_spans" in info:
+            info["recovery_spans"].sort(key=lambda s: s["start_s"])
+    return {
+        "processes": {str(pid): info for pid, info in sorted(procs.items())},
+        "timeline": timeline,
+        "decision_events": decisions,
+    }
+
+
+def _render_incident(report: Dict, out_trace: str) -> str:
+    lines: List[str] = [f"merged Perfetto trace: {out_trace}", ""]
+    for pid, info in report["processes"].items():
+        head = f"process {pid} ({info.get('kind', '?')}:{info.get('source')})"
+        if info.get("ident") is not None:
+            head += f" ident={info['ident']}"
+        if info.get("truncated"):
+            head += "  [TORN TAIL: died mid-write]"
+        lines.append(head)
+        lines.append(
+            f"  events: {info.get('events', 0)}"
+            + (
+                f", dropped at spool: {info['dropped']}"
+                if info.get("dropped")
+                else ""
+            )
+            + (
+                f", last seen unix {info['last_seen_unix']}"
+                if info.get("last_seen_unix") is not None
+                else ""
+            )
+        )
+        if info.get("last_events"):
+            tail = ", ".join(e["name"] for e in info["last_events"])
+            lines.append(f"  last events: {tail}")
+        for sp in info.get("recovery_spans", ()):
+            lines.append(
+                f"  recovery span {sp['name']}: start +{sp['start_s']:.3f}s, "
+                f"{sp['dur_s']:.3f}s"
+            )
+        lines.append("")
+    if report["timeline"]:
+        lines.append("fleet timeline (detection → drain → rebuild):")
+        rows = []
+        for ev in report["timeline"]:
+            args = ev["args"]
+            brief = ", ".join(
+                f"{k}={args[k]}"
+                for k in ("peer", "reason", "ranks", "procs", "gen", "roster",
+                          "worker", "verdict", "signal", "phase", "epoch")
+                if k in args
+            )
+            rows.append(
+                [
+                    f"+{ev['ts_us'] / 1e6:.3f}s",
+                    f"p{ev['pid']}",
+                    ev["cat"],
+                    ev["name"],
+                    brief,
+                ]
+            )
+        lines.append(_fmt_table(rows, ["t", "proc", "cat", "event", "detail"]))
+    if report["decision_events"]:
+        lines.append("")
+        lines.append(
+            f"{report['decision_events']} controller decision event(s) "
+            "recorded — `graftscope decisions` renders the journal"
+        )
+    return "\n".join(lines).rstrip()
+
+
+def postmortem(
+    dir_or_file: str, out: Optional[str] = None, as_json: bool = False
+) -> str:
+    """Stitch every spool/trace under ``dir_or_file`` into one Perfetto
+    trace and produce the incident report. Returns the rendered report (or
+    its JSON form)."""
+    out_trace = out or (
+        os.path.join(dir_or_file, "postmortem.trace.json")
+        if os.path.isdir(dir_or_file)
+        else dir_or_file + ".postmortem.trace.json"
+    )
+    sources, skipped = _gather_sources(dir_or_file, exclude={out_trace})
+    if not sources:
+        raise ValueError(f"no readable spool/trace files under {dir_or_file}")
+    merged, base0 = _merge_sources(sources)
+    payload = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "graftscope": {
+            # the marker _gather_sources keys on: this artifact is an
+            # OUTPUT, never a source for a later stitch
+            "postmortem": True,
+            "merged": [s["label"] for s in sources],
+            "skipped": skipped,
+            "truncated": [
+                s["label"] for s in sources if s.get("truncated")
+            ],
+        },
+    }
+    if base0 is not None:
+        payload["graftscope"]["base_unix"] = base0
+    tmp = out_trace + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, out_trace)
+    report = _incident_report(sources, merged, base0)
+    report["skipped"] = skipped
+    report["trace"] = out_trace
+    if as_json:
+        return json.dumps(report)
+    return _render_incident(report, out_trace)
+
+
+# -------------------------------------------------------------- decisions
+
+
+def _decision_events(path: str) -> List[dict]:
+    """cat=="decision" instants from a trace file, spool file, or directory
+    of spools — the controller journal's offline surface."""
+    if os.path.isdir(path) or path.endswith(".spool"):
+        sources, _ = _gather_sources(path)
+        events, _ = _merge_sources(sources)
+    else:
+        events = load_trace(path)
+    return [
+        e
+        for e in events
+        if e.get("ph") == "i" and e.get("cat") == "decision"
+    ]
+
+
+def decisions(path: str, as_json: bool = False) -> str:
+    """Render the online-DBS controller's decision journal: one row per
+    evaluation with verdict, reason, and the inputs behind it (modeled
+    step walls, predicted win, cost estimate, ledgers)."""
+    evs = _decision_events(path)
+    if as_json:
+        return json.dumps(
+            [{"name": e.get("name"), "ts": e.get("ts"), **(e.get("args") or {})}
+             for e in evs]
+        )
+    if not evs:
+        return "no controller decision events (run with --rebalance window and --trace on|ring)"
+    rows = []
+    for e in evs:
+        a = e.get("args") or {}
+        if e.get("name") == "dbs_deferred":
+            rows.append(
+                ["-", "-", "deferred", "-", "-", "-", "-", "-", "engine warm-gate"]
+            )
+            continue
+        verdict = "SWITCH" if a.get("switch") else "hold"
+        if e.get("name") == "dbs_switch":
+            verdict = "committed"
+        rows.append(
+            [
+                str(a.get("epoch", a.get("eval", "-"))),
+                str(a.get("window", "-")),
+                verdict,
+                a.get("reason", "-"),
+                f"{a.get('predicted_win_s', 0.0):.4f}",
+                f"{a.get('cur_step_s', 0.0):.4f}",
+                f"{a.get('new_step_s', 0.0):.4f}",
+                f"{a.get('cost_est_s', a.get('switch_cost_s', 0.0)):.4f}",
+                str(a.get("candidate_batches", a.get("batches", "-"))),
+            ]
+        )
+    return _fmt_table(
+        rows,
+        ["epoch", "win", "verdict", "reason", "win_s", "cur_step",
+         "new_step", "cost_s", "batches"],
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="graftscope",
@@ -213,6 +624,26 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("trace")
     m.add_argument("-o", "--out", default=None,
                    help="output path (default: rewrite the run trace)")
+    pm = sub.add_parser(
+        "postmortem",
+        help="flight-recorder stitcher: merge every *.spool (crash-durable "
+        "spools, torn tails tolerated) and *.trace.json under a directory "
+        "into one pid-tagged Perfetto trace + a textual incident report",
+    )
+    pm.add_argument("dir", help="directory of spools/traces (or one file)")
+    pm.add_argument("-o", "--out", default=None,
+                    help="merged trace path (default: "
+                    "<dir>/postmortem.trace.json)")
+    pm.add_argument("--json", action="store_true",
+                    help="structured incident report instead of text")
+    dc = sub.add_parser(
+        "decisions",
+        help="render the online-DBS controller's decision journal (every "
+        "switch/hold verdict with its recorded inputs) from a trace, "
+        "spool, or spool directory",
+    )
+    dc.add_argument("path")
+    dc.add_argument("--json", action="store_true")
     return p
 
 
@@ -232,6 +663,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             workers = _worker_traces(args.trace)
             out = merge_trace_files(args.trace, workers, out_path=args.out)
             print(f"merged {len(workers)} worker trace(s) -> {out}")
+        elif args.cmd == "postmortem":
+            print(postmortem(args.dir, out=args.out, as_json=args.json))
+        elif args.cmd == "decisions":
+            print(decisions(args.path, as_json=args.json))
         else:
             print(diff(args.trace_a, args.trace_b, as_json=args.json))
     except (OSError, ValueError, KeyError) as exc:

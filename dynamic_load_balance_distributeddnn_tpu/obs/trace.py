@@ -41,12 +41,15 @@ without parsing span nesting across threads.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from collections import deque
 from functools import wraps
 from typing import Dict, List, Optional, Tuple
+
+_LOG = logging.getLogger("graftscope")
 
 # Phase taxonomy: spans with cat="phase" are the NON-OVERLAPPING controller
 # segments that tile an epoch span (cat="epoch"); attribution() sums them.
@@ -139,6 +142,13 @@ class Tracer:
     ) -> "Tracer":
         if mode not in ("off", "on", "ring"):
             raise ValueError(f"trace mode must be 'off', 'on' or 'ring', got {mode!r}")
+        # a reconfigure retires any attached flight-recorder spool: the next
+        # run must not stream into the previous run's file (the writer
+        # drains synchronously, so a clean reconfigure loses nothing)
+        old_spool = getattr(self, "_spool", None)
+        if old_spool is not None:
+            old_spool.close()
+        self._spool = None
         self.mode = mode
         # deliberately unlocked: `enabled` is a write-once-per-configure
         # bool read by every span() call on pipeline/compile-pool threads —
@@ -162,11 +172,37 @@ class Tracer:
         return self
 
     def reset(self) -> None:
-        """Drop buffered events; keep the mode."""
+        """Drop buffered events; keep the mode (and any attached spool —
+        the spool records the rebase so offline realignment stays exact)."""
         self._events.clear()
         self._epoch_base = time.perf_counter()
         self._base_unix = time.time()
         self._current_epoch = None
+        if self._spool is not None:
+            self._spool.note_rebase(self._base_unix)
+
+    # --------------------------------------------------- flight recorder
+
+    def attach_spool(self, spool) -> None:
+        """Stream every subsequently emitted event into ``spool`` (an
+        :class:`~.spool.SpoolWriter`) alongside the in-memory buffer — the
+        crash-durable sink. The spool adopts this tracer's ``base_unix``
+        (realignment key) and thread-name map. One spool at a time; a
+        reconfigure or :meth:`detach_spool` closes it."""
+        if self._spool is not None:
+            self._spool.close()
+        spool._thread_names_src = self._thread_names
+        spool._write_meta(self._base_unix)
+        self._spool = spool
+
+    def detach_spool(self):
+        """Close and detach the spool (drains synchronously). Returns the
+        writer (for byte accounting) or None."""
+        sp = self._spool
+        self._spool = None
+        if sp is not None:
+            sp.close()
+        return sp
 
     def set_epoch(self, epoch: Optional[int]) -> None:
         """Stamp subsequent events with this epoch index (attribution key).
@@ -224,22 +260,30 @@ class Tracer:
         if epoch is not None:
             args = dict(args) if args else {}
             args.setdefault("epoch", epoch)
-        self._events.append(
-            (
-                name,
-                cat,
-                ph,
-                (t0 - self._epoch_base) * 1e6,  # us, Chrome-trace's unit
-                dur * 1e6,
-                tid,
-                args,
-            )
+        rec = (
+            name,
+            cat,
+            ph,
+            (t0 - self._epoch_base) * 1e6,  # us, Chrome-trace's unit
+            dur * 1e6,
+            tid,
+            args,
         )
+        self._events.append(rec)
+        sp = self._spool
+        if sp is not None:
+            sp.put(rec)
 
     # --------------------------------------------------------------- export
 
     def events(self) -> List[Tuple]:
         return list(self._events)
+
+    def event_count(self) -> int:
+        """Buffered-event count, O(1): ``len`` on the deque — never copy a
+        potentially million-tuple buffer just to measure it (the registry's
+        snapshot calls this on every poll)."""
+        return len(self._events)
 
     def chrome_events(self) -> List[dict]:
         """Buffered events as Chrome-trace-event dicts (the ``traceEvents``
@@ -336,7 +380,9 @@ def merged_names(path: str) -> List[str]:
     return []
 
 
-def merge_trace_events(paths: List[str]) -> List[dict]:
+def merge_trace_events(
+    paths: List[str], skipped: Optional[List[str]] = None
+) -> List[dict]:
     """Stitch several trace files' events into one pid-tagged stream.
 
     The first path is the PRIMARY (its timeline is the reference frame);
@@ -346,11 +392,28 @@ def merge_trace_events(paths: List[str]) -> List[dict]:
     carry (perf_counter timelines are per-process; the unix-time twin of the
     tracer base makes them comparable to wall-clock accuracy). Files from
     pids the primary doesn't know get a ``process_name`` metadata event
-    derived from their filename, so Perfetto labels the worker tracks."""
+    derived from their filename, so Perfetto labels the worker tracks.
+
+    A truncated or mid-write EXTRA file (the chaos harness kills processes
+    during ``save``) is skipped with a warning and its basename appended to
+    ``skipped`` (when a list is passed) — one torn worker file must not
+    cost the whole merge. The primary still raises: there is no reference
+    frame without it."""
     out: List[dict] = []
     base0: Optional[float] = None
     for i, path in enumerate(paths):
-        events, base = _load_trace_payload(path)
+        try:
+            events, base = _load_trace_payload(path)
+        except (OSError, ValueError) as exc:
+            if i == 0:
+                raise
+            _LOG.warning(
+                "graftscope: skipping unreadable trace file %s (%s)",
+                path, exc,
+            )
+            if skipped is not None:
+                skipped.append(os.path.basename(path))
+            continue
         if i == 0:
             base0 = base
         shift_us = 0.0
@@ -393,17 +456,23 @@ def merge_trace_files(
     extras = [p for p in extra_paths if os.path.exists(p)]
     paths = [primary] + extras
     _, base = _load_trace_payload(primary)
+    skipped: List[str] = []
+    events = merge_trace_events(paths, skipped=skipped)
     payload = {
-        "traceEvents": merge_trace_events(paths),
+        "traceEvents": events,
         "displayTimeUnit": "ms",
         # record what was stitched so a later pass (summarize auto-stitching
-        # siblings) skips these files instead of double-counting
+        # siblings) skips these files instead of double-counting; torn files
+        # surface in ``skipped`` rather than silently vanishing
         "graftscope": {
             "merged": sorted(
-                set(merged_names(primary)) | {os.path.basename(p) for p in extras}
+                set(merged_names(primary))
+                | ({os.path.basename(p) for p in extras} - set(skipped))
             )
         },
     }
+    if skipped:
+        payload["graftscope"]["skipped"] = sorted(skipped)
     if base is not None:
         payload["graftscope"]["base_unix"] = base
     tmp = out_path + ".tmp"

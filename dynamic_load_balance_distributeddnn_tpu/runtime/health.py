@@ -41,11 +41,26 @@ from typing import Callable, Dict, Iterable, List, Optional
 
 import numpy as np
 
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
+
 # Verdicts. Plain strings (not an Enum) so snapshots stay JSON-trivial.
 ALIVE = "alive"
 SUSPECT = "suspect"
 LOST = "lost"
 RECOVERING = "recovering"
+
+
+def _verdict_event(worker: int, verdict: str, **extra) -> None:
+    """Flight-recorder instant for a health-verdict TRANSITION (ISSUE 15):
+    emitted only when a worker's verdict changes, so the fleet timeline
+    shows the detection edges, not the per-window liveness chatter. One
+    attribute check when tracing is off."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return
+    args = {"worker": int(worker), "verdict": verdict}
+    args.update(extra)
+    tracer.instant("health_verdict", cat="health", args=args)
 
 
 class WorkerLost(RuntimeError):
@@ -119,12 +134,18 @@ class WorkerHealth:
                         f"is >{self.latency_factor:.0f}x the fleet median "
                         f"{med:.3f}s — SUSPECT (solver re-route territory)"
                     )
+                _verdict_event(
+                    w, SUSPECT, cause="latency",
+                    latency_s=round(float(self._latency[w]), 6),
+                    fleet_median_s=round(med, 6),
+                )
             self._lat_suspect[w] = True
         elif self._lat_suspect[w]:
             # measured back under threshold: the latency verdict lifts
             self._lat_suspect[w] = False
             if self._status[w] == SUSPECT:
                 self._status[w] = ALIVE
+                _verdict_event(w, ALIVE, cause="latency-cleared")
 
     def report_alive(self, worker: int) -> None:
         """Any positive liveness signal. A LOST worker signalling again
@@ -136,10 +157,12 @@ class WorkerHealth:
             self._status[w] = RECOVERING
             if self.logger:
                 self.logger.info(f"health: worker {w} signalling again — RECOVERING")
+            _verdict_event(w, RECOVERING)
         elif self._status[w] == SUSPECT and not self._lat_suspect[w]:
             # miss-derived suspicion clears on any liveness signal;
             # latency-derived suspicion only clears via observe_latency
             self._status[w] = ALIVE
+            _verdict_event(w, ALIVE, cause="signal")
 
     def report_miss(self, worker: int) -> bool:
         """One missed liveness signal. Returns True when this miss CONFIRMS
@@ -155,20 +178,28 @@ class WorkerHealth:
                     f"health: worker {w} missed {int(self._misses[w])} "
                     "consecutive liveness checks — LOST"
                 )
+            _verdict_event(
+                w, LOST, cause="misses", misses=int(self._misses[w])
+            )
             return True
         if self._status[w] == ALIVE:
             self._status[w] = SUSPECT
+            _verdict_event(w, SUSPECT, cause="miss")
         return False
 
     def mark_down(self, worker: int) -> None:
         """Administrative removal (the engine dropped the worker from the
         active fleet): further misses are expected and not news."""
+        if self._status[int(worker)] != LOST:
+            _verdict_event(int(worker), LOST, cause="mark_down")
         self._status[int(worker)] = LOST
         self._misses[int(worker)] = self.detect_misses
 
     def readmit(self, worker: int) -> None:
         """The engine re-added the worker to the active fleet."""
         w = int(worker)
+        if self._status[w] != ALIVE:
+            _verdict_event(w, ALIVE, cause="readmit")
         self._status[w] = ALIVE
         self._misses[w] = 0
         self._latency[w] = np.nan  # stale latency track: re-anchor on probes

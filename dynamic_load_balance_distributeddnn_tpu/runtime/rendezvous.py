@@ -235,6 +235,10 @@ def elastic_initialize(
     )
     gs.client = client
     _arm_preemption_sync(gs, client)
+    get_tracer().instant(
+        "rdzv_init", cat="rdzv",
+        args={"gen": 0, "processes": int(num_processes), "id": int(process_id)},
+    )
     heartbeat()
 
 
@@ -320,20 +324,28 @@ def drain_collective_chain(
         finally:
             done.set()
 
-    t = threading.Thread(target=_drain, daemon=True, name="rdzv-drain")
-    t.start()
-    deadline = time.monotonic() + timeout_s
-    while not done.is_set():
-        if time.monotonic() >= deadline:
-            if logger is not None:
-                logger.warning(
-                    f"rendezvous: old collective chain did not drain in "
-                    f"{timeout_s:.0f}s — proceeding (quarantine retries "
-                    "cover a late resolution)"
+    # the drain is the incident timeline's second act (detection -> DRAIN ->
+    # rebuild): span it so the postmortem stitcher can show how long the
+    # dead world's wedged collectives held the survivor up
+    with get_tracer().span("rdzv_drain", cat="recover"):
+        t = threading.Thread(target=_drain, daemon=True, name="rdzv-drain")
+        t.start()
+        deadline = time.monotonic() + timeout_s
+        while not done.is_set():
+            if time.monotonic() >= deadline:
+                if logger is not None:
+                    logger.warning(
+                        f"rendezvous: old collective chain did not drain in "
+                        f"{timeout_s:.0f}s — proceeding (quarantine retries "
+                        "cover a late resolution)"
+                    )
+                get_tracer().instant(
+                    "rdzv_drain_timeout", cat="rdzv",
+                    args={"timeout_s": float(timeout_s)},
                 )
-            return False
-        tick()
-        done.wait(0.25)
+                return False
+            tick()
+            done.wait(0.25)
     return True
 
 
@@ -457,6 +469,10 @@ def quarantine_runtime(logger=None, tick: Callable = heartbeat) -> int:
                     f"world's dispatch chain (attempt {i + 1}/{attempts}): "
                     f"{str(e)[:200]}"
                 )
+            get_tracer().instant(
+                "rdzv_quarantine_rebuild", cat="rdzv",
+                args={"attempt": i + 1, "error": str(e)[:160]},
+            )
             reset_backend()
             time.sleep(0.5 * (i + 1))
     raise RendezvousError(
@@ -530,6 +546,9 @@ class RendezvousStateMachine:
             os.path.join(self.rdzv_dir, f"join_p{self.ident}.json"),
             {"ident": self.ident},
         )
+        get_tracer().instant(
+            "rdzv_offer_join", cat="rdzv", args={"ident": self.ident}
+        )
 
     def pending_joins(self) -> Set[int]:
         out: Set[int] = set()
@@ -571,9 +590,14 @@ class RendezvousStateMachine:
         """Publish this survivor's loss verdict so peers whose beacon scan
         lags adopt it at their next boundary instead of dispatching one
         more collective against the dead process."""
+        dead = sorted(int(d) for d in dead)
         _write_json(
             os.path.join(self.rdzv_dir, f"loss_g{self.gen}_p{self.ident}.json"),
-            {"dead": sorted(int(d) for d in dead), "epoch": int(epoch)},
+            {"dead": dead, "epoch": int(epoch)},
+        )
+        get_tracer().instant(
+            "rdzv_claim_loss", cat="rdzv",
+            args={"gen": self.gen, "dead": dead, "epoch": int(epoch)},
         )
 
     def claimed_losses(self) -> Set[int]:
@@ -608,6 +632,9 @@ class RendezvousStateMachine:
         while not cond():
             now = time.monotonic()
             if now >= deadline:
+                get_tracer().instant(
+                    "rdzv_timeout", cat="rdzv", args={"phase": phase}
+                )
                 raise RendezvousTimeout(phase)
             if now - last_tick >= _TICK_EVERY_S:
                 last_tick = now
@@ -685,6 +712,16 @@ class RendezvousStateMachine:
                                 f"(round {rnd}, leader proc{leader}, "
                                 f"port {port}, epoch {agreed_epoch})"
                             )
+                            tracer.instant(
+                                "rdzv_agreed", cat="rdzv",
+                                args={
+                                    "gen": gen,
+                                    "roster": list(roster),
+                                    "round": rnd,
+                                    "leader": leader,
+                                    "epoch": agreed_epoch,
+                                },
+                            )
                             return Agreement(
                                 gen=gen,
                                 roster=tuple(roster),
@@ -736,6 +773,9 @@ class RendezvousStateMachine:
             open(
                 os.path.join(self.rdzv_dir, f"torn_g{gen}_p{self.ident}"), "w"
             ).close()
+            tracer.instant(
+                "rdzv_torn", cat="rdzv", args={"gen": gen, "ident": self.ident}
+            )
             self._wait(
                 lambda: all(
                     os.path.exists(
@@ -796,6 +836,15 @@ class RendezvousStateMachine:
             self.log(
                 f"rendezvous g{gen}: world established over {roster} "
                 f"(rank {agreement.rank}/{len(roster)} at {agreement.address})"
+            )
+            tracer.instant(
+                "rdzv_established", cat="rdzv",
+                args={
+                    "gen": gen,
+                    "roster": list(roster),
+                    "rank": agreement.rank,
+                    "address": agreement.address,
+                },
             )
             return dict(ack.get("payload") or {}) if ack else {}
 

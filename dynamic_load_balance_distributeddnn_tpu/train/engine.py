@@ -156,6 +156,44 @@ class Trainer:
         self.ws_local = cfg.world_size // self.n_proc
         self.rank_lo = self.proc_id * self.ws_local
 
+        # flight recorder (ISSUE 15): stream the tracer's events into a
+        # crash-durable per-process spool so a SIGKILL'd or wedged process
+        # leaves its timeline behind (at most the last flush interval is
+        # lost). Attached HERE — immediately after the process identity is
+        # known and before any instrumented init work (hier bandwidth
+        # probe, AOT warm) — so even a process that dies during bring-up
+        # spools its evidence. File name carries the logical ident AND the
+        # pid: a respawned joiner shares the ident with its dead
+        # predecessor but must never interleave frames into its file.
+        self._spool_writer = None
+        if cfg.trace != "off" and cfg.trace_spool:
+            from dynamic_load_balance_distributeddnn_tpu.obs.spool import (
+                SpoolWriter,
+            )
+
+            ident0 = int(os.environ.get("DBS_MH_IDENT", self.proc_id))
+            spool_path = os.path.join(
+                cfg.trace_spool, f"proc{ident0}.{os.getpid()}.spool"
+            )
+            self._spool_writer = SpoolWriter(
+                spool_path,
+                ident=ident0,
+                flush_interval_s=cfg.trace_spool_flush_s,
+                fsync=cfg.trace_spool_fsync,
+            )
+            self._trace.attach_spool(self._spool_writer)
+            self.logger.info(
+                f"flight recorder: trace spooling to {spool_path} "
+                f"(flush every {cfg.trace_spool_flush_s}s"
+                + (", fsync" if cfg.trace_spool_fsync else "")
+                + ")"
+            )
+            # drain on GC even when run() never completes — without
+            # capturing self (weakref.finalize must not pin the trainer)
+            import weakref
+
+            weakref.finalize(self, self._spool_writer.close)
+
         local_devices = sorted(jax.local_devices(), key=lambda d: d.id)
         ids_global = cfg.worker_device_ids(len(local_devices))
         ids_local = ids_global[self.rank_lo : self.rank_lo + self.ws_local]
@@ -1503,6 +1541,22 @@ class Trainer:
         )
         return self.recorder
 
+    def close_spool(self):
+        """Drain and close the flight-recorder spool (idempotent; returns
+        the closed writer for byte accounting, or None). The ONE external
+        teardown surface — bench arms and test harnesses that drive epochs
+        without run() call this instead of reaching into the tracer."""
+        if self._spool_writer is None:
+            return None
+        sp = self._trace.detach_spool()
+        self._spool_writer = None
+        if sp is not None:
+            self.logger.info(
+                f"flight recorder: spool closed ({sp.path}, "
+                f"{sp.bytes_written} bytes)"
+            )
+        return sp
+
     def save_trace(self) -> Optional[str]:
         """Persist the graftscope trace (Chrome-trace JSON under
         cfg.trace_dir, config-encoded filename per process) when tracing is
@@ -1510,6 +1564,10 @@ class Trainer:
         or open in ui.perfetto.dev next to a --profile_dir device trace."""
         if not self._trace.enabled:
             return None
+        # flight recorder: a clean end of run drains and closes the spool
+        # (everything buffered reaches disk) — the crash path needs no
+        # cooperation, the flusher thread already wrote all but the tail
+        self.close_spool()
         path = os.path.join(
             self.cfg.trace_dir,
             self.cfg.base_filename().format(self.proc_id) + ".trace.json",
@@ -1839,6 +1897,13 @@ class Trainer:
                 f"elastic: peer {ident} unreachable ({reason}) — survivors "
                 "will re-rendezvous at the next boundary (a wedged "
                 "collective against the dead peer errors or aborts first)"
+            )
+            # flight-recorder detection edge: emitted from the WATCHER
+            # thread — exactly the thread that still runs when the
+            # controller is wedged in a collective against the dead peer
+            get_tracer().instant(
+                "peer_stale", cat="elastic",
+                args={"peer": ident, "reason": reason},
             )
             try:
                 with open(
@@ -2280,6 +2345,7 @@ class Trainer:
         # recorder's per-epoch switch-delta baseline restarts with it, or
         # the first post-reshard epoch would record a negative delta.
         self._rebalance_ctl = None
+        self.obs.controller = None  # registry slot follows the rebuild
         self._switches_last = 0
         # warm-started runs re-warm the NEW world size's compile universe:
         # _maybe_warm (next epoch entry) submits the gen's ladder to the
@@ -2322,6 +2388,10 @@ class Trainer:
         t0 = self._detect_t0 or time.perf_counter()
         snap = self._epoch_snap
         with self._trace.span("recover", cat="recover"):
+            self._trace.instant(
+                "worker_lost", cat="elastic",
+                args={"ranks": sorted(int(r) for r in lost), "epoch": int(epoch)},
+            )
             self.logger.warning(
                 f"elastic: worker(s) {sorted(lost)} confirmed lost at epoch "
                 f"{epoch} — re-solving over survivors"
@@ -2383,6 +2453,7 @@ class Trainer:
             }
             self._elastic_events.append(ev)
             self.recorder.meta["elastic_events"] = self._elastic_events
+            self._trace.instant("recovered", cat="elastic", args=dict(ev))
             self.logger.info(
                 f"elastic: recovered over {self.world_size} survivors "
                 f"{self.active_ranks} in {dt:.3f}s (detection to resumed "
@@ -2471,6 +2542,10 @@ class Trainer:
             "checkpoint"
         )
         self.logger.error(msg)
+        self._trace.instant(
+            "rdzv_failed", cat="rdzv",
+            args={"phase": str(phase), "epoch": int(epoch)},
+        )
         if self._hb_beacon_path:
             from dynamic_load_balance_distributeddnn_tpu.runtime.watchdog import (
                 tag_exit_reason,
@@ -2508,6 +2583,14 @@ class Trainer:
             f"elastic: worker(s) {sorted(lost)} (peer process(es) "
             f"{dead_procs}) confirmed lost at epoch {epoch} — "
             "re-rendezvousing over survivors"
+        )
+        self._trace.instant(
+            "peer_lost", cat="elastic",
+            args={
+                "ranks": sorted(int(r) for r in lost),
+                "procs": [int(p) for p in dead_procs],
+                "epoch": int(epoch),
+            },
         )
         for r in lost:
             self.health.mark_down(r)
@@ -2815,6 +2898,7 @@ class Trainer:
                 ]
             self._elastic_events.append(ev)
             self.recorder.meta["elastic_events"] = self._elastic_events
+            self._trace.instant("mh_recovered", cat="elastic", args=dict(ev))
             self.logger.info(
                 f"elastic: re-rendezvous g{agreement.gen} complete — "
                 f"{self.world_size} workers over {self.n_proc} process(es) "
@@ -2903,6 +2987,10 @@ class Trainer:
         if not cands:
             return
         with self._trace.span("readmit", cat="recover"):
+            self._trace.instant(
+                "readmitted", cat="elastic",
+                args={"ranks": [int(r) for r in cands], "epoch": int(epoch)},
+            )
             self.logger.info(
                 f"elastic: readmitting worker(s) {cands} at epoch {epoch}"
             )
@@ -4261,6 +4349,9 @@ class Trainer:
                 rate_alpha=cfg.rebalance_rate_alpha,
                 logger=self.logger,
             )
+            # decision journal on the registry snapshot (ISSUE 15): the
+            # controller's ledgers + last verdict become queryable live
+            self.obs.attach(controller=self._rebalance_ctl)
         return self._rebalance_ctl
 
     def _window_rates(self) -> Optional[np.ndarray]:

@@ -149,6 +149,15 @@ def main_rdzv(proc_id: int, num_procs: int, port: int) -> None:
 
     bundle = synthetic_dataset("mnist", n_train=512, n_test=128)
     cfg = _elastic_cfg(ws, num_procs, epochs, ck)
+    if os.environ.get("DBS_MH_TRACE_SPOOL"):
+        # flight-recorder chaos mode (ISSUE 15): ring-trace + crash-durable
+        # spool, fast flush so the SIGKILL window is tight
+        cfg = cfg.replace(
+            trace="ring",
+            trace_spool=os.environ["DBS_MH_TRACE_SPOOL"],
+            trace_spool_flush_s=0.05,
+            trace_dir=os.path.join(os.environ["DBS_MH_TRACE_SPOOL"], "traces"),
+        )
     holder = {}
     factors = ([3.0, 1.0, 1.0, 1.0] * 2)[:ws]
     tr = Trainer(
@@ -176,6 +185,9 @@ def main_rdzv(proc_id: int, num_procs: int, port: int) -> None:
 
             _time.sleep(epoch_sleep)
     flush_checkpoints(cfg.ckpt_dir, close=True)
+    # survivors drain their spool cleanly (victims are SIGKILLed — the
+    # background flusher already persisted all but the last interval)
+    tr.close_spool()
     rec = tr.recorder
     out = {
         "proc": proc_id,

@@ -380,6 +380,78 @@ def test_mh_kill_shrink_respawn_regrow(tmp_path):
     assert all(c == 0 for c in r0["xla_compiles"][grow_epoch + 1:])
 
 
+def test_mh_sigkill_spool_postmortem(tmp_path):
+    """ISSUE 15 acceptance: a REAL 2-process elastic run with the flight
+    recorder on (`--trace ring --trace_spool`) where one peer is SIGKILLed
+    mid-run. The victim's spool must survive its process (readable, torn
+    tail tolerated) with its last events; `graftscope postmortem` over the
+    spool directory must produce ONE merged pid-tagged Perfetto trace
+    holding the victim's final evidence next to the survivor's rendezvous
+    state-machine spans, plus the textual incident report."""
+    from dynamic_load_balance_distributeddnn_tpu.obs.scope_cli import (
+        postmortem,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.obs.spool import read_spool
+
+    port = _free_port()
+    spool_dir = tmp_path / "spool"
+    procs, logs, _env = _spawn_rdzv_workers(
+        tmp_path, 2, port, epochs=3,
+        env_extra={"DBS_MH_TRACE_SPOOL": str(spool_dir)},
+    )
+    hb = tmp_path / "hb"
+    try:
+        assert _wait_for(
+            hb / "epoch1_p1.marker", procs
+        ), "fleet never reached epoch 1"
+        procs[1].send_signal(signal.SIGKILL)
+        rc0 = procs[0].wait(timeout=300)
+        rc1 = procs[1].wait(timeout=30)
+    finally:
+        _kill_all(procs)
+    assert rc1 == -signal.SIGKILL
+    out0 = open(str(logs[0])).read()
+    assert rc0 == 0, f"survivor failed:\n{out0[-4000:]}"
+
+    spools = {p.name.split(".")[0]: p for p in spool_dir.glob("*.spool")}
+    assert set(spools) == {"proc0", "proc1"}, sorted(spool_dir.iterdir())
+    # the victim's spool is readable WITHOUT its process: the background
+    # flusher persisted its timeline up to the last flush interval
+    victim = read_spool(str(spools["proc1"]))
+    victim_events = [e for _, seg in victim["segments"] for e in seg]
+    assert victim_events, "victim spool holds no events"
+    assert victim["meta"]["ident"] == 1
+    # it was training when it died: epoch-1 work is in the spooled tail
+    names = {e[0] for e in victim_events}
+    assert "epoch" in names or "dispatch_window" in names or "probe" in names
+
+    report = json.loads(postmortem(str(spool_dir), as_json=True))
+    merged_path = spool_dir / "postmortem.trace.json"
+    assert str(merged_path) == report["trace"] and merged_path.exists()
+    merged = json.loads(merged_path.read_text())
+    evs = merged["traceEvents"]
+    pids = {e.get("pid") for e in evs if e.get("ph") != "M"}
+    assert len(pids) == 2, "merged trace must keep both processes' tracks"
+    by_name = {e["name"] for e in evs}
+    # the survivor's rendezvous state machine made it onto the timeline...
+    assert {"rdzv_agree", "rdzv_establish"} <= by_name, sorted(by_name)[:40]
+    assert "peer_lost" in by_name or "peer_stale" in by_name
+    # ...and the victim's last events are in the SAME artifact
+    victim_pid = int(victim["meta"]["pid"])
+    assert any(
+        e.get("pid") == victim_pid and e.get("ph") != "M" for e in evs
+    )
+    # the incident report narrates both processes
+    procs_report = report["processes"]
+    assert str(victim_pid) in procs_report
+    surv = next(
+        info for pid, info in procs_report.items() if int(pid) != victim_pid
+    )
+    span_names = {s["name"] for s in surv.get("recovery_spans", ())}
+    assert {"rdzv_agree", "rdzv_establish"} <= span_names
+    assert any(ev["name"] == "rdzv_agreed" for ev in report["timeline"])
+
+
 def test_elastic_peer_loss_detection(tmp_path):
     """ISSUE 6 multi-host story: cross-process recovery is deliberately out
     of scope (a dead peer takes its mesh slice with it — README "Fault

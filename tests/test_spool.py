@@ -1,0 +1,559 @@
+"""Flight recorder (ISSUE 15): crash-durable spool writer/reader, tracer
+integration, postmortem stitching, the controller decision journal, and the
+hardened registry/merge surfaces."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.obs.spool import (
+    SpoolWriter,
+    read_spool,
+    spool_to_chrome,
+)
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
+    EPOCH_CAT,
+    Tracer,
+    attribution,
+    load_trace,
+    merge_trace_events,
+    merge_trace_files,
+)
+from dynamic_load_balance_distributeddnn_tpu.obs.scope_cli import main as scope_main
+
+
+def _mk_spool(path, **kw):
+    kw.setdefault("flush_interval_s", 0.02)
+    return SpoolWriter(str(path), **kw)
+
+
+# --------------------------------------------------------------- round trip
+
+
+def test_spool_roundtrip_preserves_events_and_meta(tmp_path):
+    path = tmp_path / "p.spool"
+    sp = _mk_spool(path, ident=3, base_unix=123.5)
+    recs = [
+        ("train", "phase", "X", 10.0, 5.0, 1, {"epoch": 0}),
+        ("beat", "heartbeat", "i", 16.0, 0.0, 1, None),
+    ]
+    for r in recs:
+        sp.put(r)
+    sp.close()
+    got = read_spool(str(path))
+    assert not got["truncated"]
+    assert got["meta"]["ident"] == 3
+    assert got["meta"]["base_unix"] == 123.5
+    (base, events), = got["segments"]
+    assert base == 123.5
+    assert [tuple(e) for e in events] == [
+        ("train", "phase", "X", 10.0, 5.0, 1, {"epoch": 0}),
+        ("beat", "heartbeat", "i", 16.0, 0.0, 1, None),
+    ]
+
+
+def test_spool_background_flusher_persists_without_close(tmp_path):
+    """The crash-durability property: events reach disk on the flush
+    cadence, with no cooperation from the (about-to-die) emitter."""
+    path = tmp_path / "p.spool"
+    sp = _mk_spool(path, flush_interval_s=0.02)
+    sp.put(("alive", "phase", "X", 0.0, 1.0, 1, None))
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        got = read_spool(str(path))
+        if sum(len(e) for _, e in got["segments"]) == 1:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("flusher never wrote the event")
+    sp.close()
+
+
+def test_spool_torn_tail_is_tolerated(tmp_path):
+    """A SIGKILL mid-write leaves a final frame shorter than its length
+    header claims: the reader returns every complete frame plus
+    truncated=True — never an exception, never a guessed record."""
+    path = tmp_path / "p.spool"
+    sp = _mk_spool(path)
+    sp.put(("first", "phase", "X", 0.0, 1.0, 1, None))
+    sp.flush()
+    sp.put(("second", "phase", "X", 2.0, 1.0, 1, None))
+    sp.close()
+    data = path.read_bytes()
+    path.write_bytes(data[:-9])  # tear the last frame mid-body
+    got = read_spool(str(path))
+    assert got["truncated"]
+    events = [e for _, seg in got["segments"] for e in seg]
+    assert [e[0] for e in events] == ["first"]
+    # chrome conversion carries the truncation verdict through
+    ch = spool_to_chrome(str(path))
+    assert ch["truncated"] and len(ch["events"]) == 1
+
+
+def test_spool_bounded_queue_drops_oldest_and_counts(tmp_path):
+    path = tmp_path / "p.spool"
+    sp = SpoolWriter(
+        str(path), flush_interval_s=30.0, max_queue=8, watermark=10**9
+    )
+    for i in range(20):
+        sp.put((f"e{i}", "phase", "X", float(i), 1.0, 1, None))
+    sp.close()
+    got = read_spool(str(path))
+    events = [e for _, seg in got["segments"] for e in seg]
+    assert [e[0] for e in events] == [f"e{i}" for i in range(12, 20)]
+    assert got["dropped"] == 12
+
+
+def test_spool_rebase_is_not_counted_as_a_drop(tmp_path):
+    """Regression: a rebase sentinel is a consumed record, not a lost
+    event — a Tracer.reset() with a spool attached must never fabricate a
+    `dropped` count in the incident evidence."""
+    path = tmp_path / "p.spool"
+    sp = _mk_spool(path, flush_interval_s=30.0)
+    sp.put(("a", "phase", "X", 0.0, 1.0, 1, None))
+    sp.put(("b", "phase", "X", 1.0, 1.0, 1, None))
+    sp.note_rebase(777.0)
+    sp.put(("c", "phase", "X", 0.5, 1.0, 1, None))
+    sp.close()
+    got = read_spool(str(path))
+    assert got["dropped"] == 0
+    assert [b for b, _ in got["segments"]][-1] == 777.0
+    # and a REAL overflow after a rebase is still reported
+    sp2 = SpoolWriter(
+        str(tmp_path / "q.spool"), flush_interval_s=30.0, max_queue=4,
+        watermark=10**9,
+    )
+    sp2.note_rebase(1.0)
+    for i in range(9):
+        sp2.put((f"e{i}", "phase", "X", float(i), 1.0, 1, None))
+    sp2.close()
+    got2 = read_spool(str(tmp_path / "q.spool"))
+    events2 = [e for _, seg in got2["segments"] for e in seg]
+    assert len(events2) == 4  # queue kept the newest 4 (sentinel evicted too)
+    assert got2["dropped"] == 6  # 10 queued records - 4 surviving
+
+
+# --------------------------------------------------------- tracer integration
+
+
+def test_tracer_streams_into_attached_spool(tmp_path):
+    tr = Tracer(mode="on")
+    path = tmp_path / "t.spool"
+    sp = _mk_spool(path, ident=0)
+    tr.attach_spool(sp)
+    tr.set_epoch(2)
+    with tr.span("epoch", cat=EPOCH_CAT):
+        with tr.span("train"):
+            pass
+    tr.instant("beat", cat="heartbeat")
+    assert tr.detach_spool() is sp
+    ch = spool_to_chrome(str(path))
+    names = [e["name"] for e in ch["events"] if e.get("ph") != "M"]
+    assert names == ["train", "epoch", "beat"]
+    # the spool adopts the tracer's realignment base, and epoch stamping
+    # rides through the spool exactly as through the in-memory buffer
+    assert ch["base_unix"] == pytest.approx(tr._base_unix)
+    spans = [e for e in ch["events"] if e["ph"] == "X"]
+    assert all(e["args"]["epoch"] == 2 for e in spans)
+    # thread-name metadata made it across
+    assert any(e["ph"] == "M" for e in ch["events"])
+
+
+def test_tracer_reset_rebases_spool_segments(tmp_path):
+    tr = Tracer(mode="on")
+    path = tmp_path / "t.spool"
+    tr.attach_spool(_mk_spool(path))
+    tr.instant("before", cat="x")
+    base1 = tr._base_unix
+    tr.reset()
+    tr.instant("after", cat="x")
+    base2 = tr._base_unix
+    tr.detach_spool()
+    got = read_spool(str(path))
+    assert not got["truncated"]
+    bases = [b for b, _ in got["segments"]]
+    assert bases == [pytest.approx(base1), pytest.approx(base2)]
+
+
+def test_tracer_reconfigure_closes_spool(tmp_path):
+    tr = Tracer(mode="on")
+    sp = _mk_spool(tmp_path / "t.spool")
+    tr.attach_spool(sp)
+    tr.configure("off")
+    assert sp._stop.is_set()  # closed, drained
+    assert tr._spool is None
+
+
+def test_event_count_is_len_without_copy():
+    tr = Tracer(mode="on")
+    for _ in range(5):
+        tr.instant("e", cat="x")
+    assert tr.event_count() == 5 == len(tr.events())
+    tr.configure("off")
+    assert tr.event_count() == 0
+
+
+# ------------------------------------------------------ postmortem stitching
+
+
+def _fake_process_spool(path, pid, ident, base_unix, events):
+    sp = SpoolWriter(
+        str(path), pid=pid, ident=ident, base_unix=base_unix,
+        flush_interval_s=30.0,
+    )
+    for e in events:
+        sp.put(e)
+    sp.close()
+
+
+def test_postmortem_merges_spools_realigned_and_reports(tmp_path, capsys):
+    # survivor came up 2s before the victim; victim dies with a torn tail
+    _fake_process_spool(
+        tmp_path / "proc0.100.spool", 100, 0, 1000.0,
+        [
+            ("rdzv_agree", "recover", "X", 50.0, 30.0, 1, {"gen": 1}),
+            ("peer_stale", "elastic", "i", 40.0, 0.0, 1,
+             {"peer": "proc1", "reason": "stale 2.0s"}),
+        ],
+    )
+    _fake_process_spool(
+        tmp_path / "proc1.101.spool", 101, 1, 1002.0,
+        [
+            ("train", "phase", "X", 10.0, 5.0, 2, {"epoch": 1}),
+            ("last_gasp", "dispatch", "X", 20.0, 1.0, 2, None),
+        ],
+    )
+    vic = tmp_path / "proc1.101.spool"
+    vic.write_bytes(vic.read_bytes() + b"999 {torn")  # mid-write tail
+    assert scope_main(["postmortem", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "TORN TAIL" in out
+    assert "peer_stale" in out and "rdzv_agree" in out and "last_gasp" in out
+    merged = json.load(open(tmp_path / "postmortem.trace.json"))
+    evs = merged["traceEvents"]
+    by_pid = {e["pid"] for e in evs}
+    assert by_pid == {100, 101}
+    # realignment: victim events shift by the 2s base delta into the
+    # survivor's (earlier) frame
+    gasp = next(e for e in evs if e["name"] == "last_gasp")
+    assert gasp["ts"] == pytest.approx(20.0 + 2.0e6)
+    assert merged["graftscope"]["truncated"] == ["proc1.101"]
+    assert merged["graftscope"]["base_unix"] == 1000.0
+
+
+def test_postmortem_json_structure(tmp_path):
+    _fake_process_spool(
+        tmp_path / "proc0.7.spool", 7, 0, 500.0,
+        [("recover_mh", "recover", "X", 0.0, 9.0, 1, None)],
+    )
+    from dynamic_load_balance_distributeddnn_tpu.obs.scope_cli import postmortem
+
+    report = json.loads(postmortem(str(tmp_path), as_json=True))
+    assert report["processes"]["7"]["recovery_spans"][0]["name"] == "recover_mh"
+    assert report["trace"].endswith("postmortem.trace.json")
+
+
+def test_postmortem_empty_dir_errors(tmp_path):
+    assert scope_main(["postmortem", str(tmp_path)]) == 2
+
+
+def test_postmortem_never_reingests_its_own_output(tmp_path):
+    """Regression: a previous postmortem output — under the default name OR
+    a custom -o inside the scanned directory — is an artifact, not a
+    source; re-running must not double-count its tracks."""
+    from dynamic_load_balance_distributeddnn_tpu.obs.scope_cli import postmortem
+
+    _fake_process_spool(
+        tmp_path / "proc0.60.spool", 60, 0, 10.0,
+        [("train", "phase", "X", 0.0, 5.0, 1, None)],
+    )
+    custom = tmp_path / "merged.trace.json"
+    postmortem(str(tmp_path), out=str(custom))
+    report = json.loads(postmortem(str(tmp_path), as_json=True))
+    assert report["processes"]["60"]["events"] == 1
+    merged = json.load(open(tmp_path / "postmortem.trace.json"))
+    trains = [
+        e for e in merged["traceEvents"] if e.get("name") == "train"
+    ]
+    assert len(trains) == 1
+
+
+def test_trace_spool_requires_tracing():
+    from dynamic_load_balance_distributeddnn_tpu.config import Config
+
+    with pytest.raises(ValueError, match="trace_spool requires tracing"):
+        Config(trace="off", trace_spool="/tmp/x")
+
+
+def test_postmortem_dedups_trace_covered_by_spool(tmp_path):
+    """Regression: a run trace saved by the SAME pid as a spool (e.g.
+    --trace_dir pointing into the spool directory) must not double-count
+    that process's events — the spool is canonical; pids without a spool
+    (a merged compile-worker track) survive from the trace."""
+    _fake_process_spool(
+        tmp_path / "proc0.50.spool", 50, 0, 100.0,
+        [("train", "phase", "X", 0.0, 5.0, 1, {"epoch": 0})],
+    )
+    trace = {
+        "traceEvents": [
+            # duplicate of the spooled process...
+            {"name": "train", "cat": "phase", "ph": "X", "ts": 0.0,
+             "dur": 5.0, "pid": 50, "tid": 1},
+            # ...plus a worker track no spool covers
+            {"name": "aot_compile", "cat": "compile", "ph": "X", "ts": 1.0,
+             "dur": 2.0, "pid": 51, "tid": 1},
+        ],
+        "graftscope": {"base_unix": 100.0},
+    }
+    (tmp_path / "run.trace.json").write_text(json.dumps(trace))
+    from dynamic_load_balance_distributeddnn_tpu.obs.scope_cli import postmortem
+
+    report = json.loads(postmortem(str(tmp_path), as_json=True))
+    merged = json.load(open(tmp_path / "postmortem.trace.json"))
+    trains = [
+        e for e in merged["traceEvents"]
+        if e.get("name") == "train" and e.get("ph") == "X"
+    ]
+    assert len(trains) == 1, "spooled process double-counted"
+    assert any(
+        e.get("pid") == 51 for e in merged["traceEvents"]
+    ), "worker track lost in dedup"
+    assert report["processes"]["50"]["events"] == 1
+
+
+# -------------------------------------------------------- decision journal
+
+
+def test_controller_journals_every_verdict_and_traces_them():
+    from dynamic_load_balance_distributeddnn_tpu.balance.controller import (
+        OnlineRebalanceController,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
+        configure as configure_tracer,
+        get_tracer,
+    )
+
+    configure_tracer("on")
+    try:
+        ctl = OnlineRebalanceController(2, 64, [[0], [1]])
+        ctl.observe_rates(np.array([0.001, 0.003]))
+        hold = ctl.propose(np.array([0.001, 0.003]), np.array([32, 32]), 0)
+        assert not hold.switch and hold.reason == "no-horizon"
+        dec = ctl.propose(np.array([0.001, 0.003]), np.array([32, 32]), 200)
+        assert dec.switch
+        ctl.commit(dec, 0.02, epoch=1, window=3, step=12)
+        j = ctl.decision_journal()
+        assert [e["reason"] for e in j] == ["no-horizon", "switch"]
+        # the committed evaluation is annotated with what actually happened
+        assert j[-1]["outcome"] == "committed"
+        assert j[-1]["epoch"] == 1 and j[-1]["measured_cost_s"] == 0.02
+        # inputs recorded: rates, batches, ledgers, hysteresis state
+        assert j[-1]["eff_rates"] == [0.001, 0.003]
+        assert j[-1]["cur_batches"] == [32, 32]
+        assert "candidate_batches" in j[-1] and "wall_scale" in j[-1]
+        # snapshot carries the journal's live surface
+        snap = ctl.snapshot()
+        assert snap["decisions"] == 2
+        assert snap["last_decision"]["reason"] == "switch"
+        # trace instants: one decision per evaluation + the commit marker
+        evs = [e for e in get_tracer().events() if e[1] == "decision"]
+        assert [e[0] for e in evs] == [
+            "dbs_decision", "dbs_decision", "dbs_switch"
+        ]
+    finally:
+        configure_tracer("off")
+
+
+def test_graftscope_decisions_cli(tmp_path, capsys):
+    from dynamic_load_balance_distributeddnn_tpu.balance.controller import (
+        OnlineRebalanceController,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
+        configure as configure_tracer,
+        get_tracer,
+    )
+
+    configure_tracer("on")
+    try:
+        ctl = OnlineRebalanceController(2, 64, [[0], [1]])
+        dec = ctl.propose(np.array([0.001, 0.003]), np.array([32, 32]), 200)
+        assert dec.switch
+        ctl.commit(dec, 0.02, epoch=4, window=1, step=3)
+        ctl.propose(np.array([0.001, 0.001]), np.array([48, 16]), 1)
+        path = get_tracer().save(str(tmp_path / "run.trace.json"))
+    finally:
+        configure_tracer("off")
+    assert scope_main(["decisions", path]) == 0
+    out = capsys.readouterr().out
+    assert "switch" in out and "committed" in out
+    assert scope_main(["decisions", path, "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    reasons = [r.get("reason") for r in rows if r["name"] == "dbs_decision"]
+    assert "switch" in reasons
+    # every decision row carries its inputs — the offline "why"
+    first = next(r for r in rows if r.get("reason") == "switch")
+    assert {"predicted_win_s", "cur_step_s", "cost_est_s",
+            "remaining_steps"} <= set(first)
+
+
+# ------------------------------------------- merged multi-process attribution
+
+
+def _two_process_trace_files(tmp_path):
+    """Two realigned per-process trace files with pid-tagged epoch/phase
+    spans (the satellite's merged-attribution fixture): process B's file
+    carries a base_unix 1s later and a forged pid."""
+    tr = Tracer(mode="on")
+    tr.set_epoch(0)
+    with tr.span("epoch", cat=EPOCH_CAT):
+        with tr.span("train"):
+            pass
+    pa = tr.save(str(tmp_path / "a.trace.json"))
+    tr2 = Tracer(mode="on")
+    tr2.set_epoch(0)
+    with tr2.span("epoch", cat=EPOCH_CAT):
+        with tr2.span("validate"):
+            pass
+    pb = tr2.save(str(tmp_path / "b.trace.json"))
+    payload = json.load(open(pb))
+    payload["graftscope"]["base_unix"] = (
+        json.load(open(pa))["graftscope"]["base_unix"] + 1.0
+    )
+    for ev in payload["traceEvents"]:
+        ev["pid"] = 99999
+    json.dump(payload, open(pb, "w"))
+    return pa, pb
+
+
+def test_attribution_over_merged_multiprocess_events(tmp_path):
+    pa, pb = _two_process_trace_files(tmp_path)
+    merged = merge_trace_events([pa, pb])
+    # realignment: process B's spans landed ~1s after A's in A's frame
+    b_epoch = [
+        e for e in merged
+        if e.get("pid") == 99999 and e.get("name") == "epoch"
+    ]
+    assert b_epoch and b_epoch[0]["ts"] >= 1e6 * 0.99
+    att = attribution(merged)
+    info = att["epochs"][0]
+    # fleet-level attribution: both processes' epoch walls sum, and the
+    # phase table carries each process's phases side by side
+    assert set(info["phases"]) == {"train", "validate"}
+    assert info["wall_s"] >= info["phases"]["train"] + info["phases"]["validate"]
+    assert att["coverage_min"] is not None
+
+
+def test_merge_trace_files_skips_torn_extras(tmp_path):
+    pa, pb = _two_process_trace_files(tmp_path)
+    torn = tmp_path / "compile_worker_torn.trace.json"
+    torn.write_text('{"traceEvents": [{"name": "half')  # mid-write kill
+    out = merge_trace_files(pa, [pb, str(torn)], out_path=str(tmp_path / "m.json"))
+    payload = json.load(open(out))
+    assert payload["graftscope"]["skipped"] == ["compile_worker_torn.trace.json"]
+    assert "b.trace.json" in payload["graftscope"]["merged"]
+    assert "compile_worker_torn.trace.json" not in payload["graftscope"]["merged"]
+    # the good extra's events made it in
+    assert any(e.get("pid") == 99999 for e in payload["traceEvents"])
+    # load_trace still reads the merged artifact
+    assert load_trace(out)
+
+
+# -------------------------------------------------------- engine integration
+
+
+def test_engine_spools_and_closes_at_save(tmp_path):
+    """--trace ring + --trace_spool end to end on a real (tiny) run: the
+    engine attaches the spool at init, the run's spans stream into it, and
+    save_trace drains + closes it — the spool replays the same phases the
+    in-memory trace holds."""
+    from dynamic_load_balance_distributeddnn_tpu.config import Config
+    from dynamic_load_balance_distributeddnn_tpu.data.datasets import (
+        synthetic_dataset,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
+        configure as configure_tracer,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+    spool_dir = tmp_path / "spool"
+    cfg = Config(
+        debug=True,
+        world_size=2,
+        batch_size=64,
+        learning_rate=0.05,
+        epoch_size=1,
+        dataset="mnist",
+        model="mnistnet",
+        dynamic_batch_size=True,
+        bucket=8,
+        trace="ring",
+        trace_spool=str(spool_dir),
+        trace_spool_flush_s=0.05,
+        trace_dir=str(tmp_path / "traces"),
+        stat_dir=str(tmp_path / "statis"),
+        log_dir=str(tmp_path / "logs"),
+    )
+    bundle = synthetic_dataset("mnist", n_train=256, n_test=64)
+    tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+    try:
+        assert tr._spool_writer is not None
+        spool_path = tr._spool_writer.path
+        tr.run(epochs=1)
+        # save_trace (inside run) detached + drained the spool
+        assert tr._spool_writer is None
+        ch = spool_to_chrome(spool_path)
+        assert not ch["truncated"]
+        names = {e["name"] for e in ch["events"]}
+        assert "epoch" in names and "train" in names
+        # the spool carries the SAME epoch-stamped phases the in-memory
+        # trace exports — attribution works on spooled evidence alone
+        att = attribution(ch["events"])
+        assert 0 in att["epochs"] and att["epochs"][0]["phases"]
+    finally:
+        configure_tracer("off")
+
+
+# ------------------------------------------------------- registry hardening
+
+
+def test_registry_snapshot_survives_torn_down_runtime(monkeypatch):
+    """device_peak_memory must degrade — not raise — when jax's runtime is
+    mid-rendezvous (local_devices() raising is exactly the torn-down
+    state)."""
+    import jax
+
+    from dynamic_load_balance_distributeddnn_tpu.obs.registry import (
+        MetricsRegistry,
+        device_peak_memory,
+    )
+
+    def _boom():
+        raise RuntimeError("backend torn down")
+
+    monkeypatch.setattr(jax, "local_devices", _boom)
+    mem = device_peak_memory()
+    assert mem["source"] == "unavailable" and "torn down" in mem["error"]
+    snap = MetricsRegistry(tracer=Tracer(mode="off")).snapshot()
+    assert snap["memory"]["source"] == "unavailable"
+
+
+def test_registry_controller_surface():
+    from dynamic_load_balance_distributeddnn_tpu.balance.controller import (
+        OnlineRebalanceController,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.obs.registry import (
+        MetricsRegistry,
+    )
+
+    ctl = OnlineRebalanceController(2, 64, [[0], [1]])
+    ctl.propose(np.array([0.001, 0.003]), np.array([32, 32]), 100)
+    reg = MetricsRegistry(tracer=Tracer(mode="off")).attach(controller=ctl)
+    snap = reg.snapshot()
+    assert snap["controller"]["decisions"] == 1
+    assert snap["controller"]["last_decision"]["reason"] in (
+        "switch", "below-hysteresis", "below-margin", "budget-exhausted",
+        "same-plan",
+    )

@@ -14,7 +14,9 @@ Usage::
 ``--flow`` adds the whole-program rules (G011 donation lifetimes, G012
 thread/lock discipline, G013 stale-mesh placement, and the graftmesh
 families: G014 collective/axis consistency, G015 sharding-spec flow, G016
-non-uniform shard arithmetic) on top of the single-file ones; selecting a
+non-uniform shard arithmetic; and the graftrdzv families: G017
+protocol-file discipline, G018 recovery phase order, G019 quiesce
+discipline) on top of the single-file ones; selecting a
 flow code implies it. ``--format json|sarif`` emits machine-readable
 findings (SARIF for per-line CI annotation — ``scripts/lint_sarif.sh`` is
 the wired CI invocation). Findings are cached by file content hash and the
@@ -58,7 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
             "rules: donation lifetimes (G011), thread/lock discipline "
             "(G012), stale-mesh placement (G013), collective/axis "
             "consistency (G014), sharding-spec flow (G015), non-uniform "
-            "shard arithmetic (G016)."
+            "shard arithmetic (G016), rendezvous protocol-file discipline "
+            "(G017), recovery phase order (G018), quiesce-before-reshard "
+            "(G019)."
         ),
     )
     parser.add_argument(
@@ -81,7 +85,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--flow",
         action="store_true",
-        help="run the whole-program dataflow rules (G011-G016) too",
+        help="run the whole-program dataflow rules (G011-G019) too",
     )
     parser.add_argument(
         "--format",

@@ -11,7 +11,11 @@ lifetimes (G011), thread/lock discipline (G012), and stale-mesh placement
 semantics on top — a :class:`~.mesh.MeshModel` of mesh constructions, axis
 names, and sharding-spec identities feeding G014 (collective/axis
 consistency), G015 (sharding-spec flow), and G016 (non-uniform shard
-arithmetic). ``graftlint --flow`` is the CLI entry; :func:`analyze_paths`
+arithmetic). proto.py layers graftrdzv: the rendezvous PROTOCOL table is
+extracted into an automaton feeding G017 (protocol-file discipline), G018
+(recovery phase order), G019 (quiesce before topology mutation), a
+small-scope model checker, and the ``graftscope conformance`` trace
+replay. ``graftlint --flow`` is the CLI entry; :func:`analyze_paths`
 the library one.
 """
 
@@ -32,6 +36,13 @@ from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import (
 )
 from dynamic_load_balance_distributeddnn_tpu.analysis.flow.mesh import (
     MeshModel,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.proto import (
+    ProtocolModel,
+    check_conformance,
+    extract_protocol,
+    load_protocol,
+    run_model_check,
 )
 from dynamic_load_balance_distributeddnn_tpu.analysis.flow.rules import (
     FLOW_RULES,
@@ -62,9 +73,14 @@ __all__ = [
     "MeshModel",
     "ModuleSummary",
     "Project",
+    "ProtocolModel",
     "analyze_paths",
     "analyze_source",
+    "check_conformance",
+    "extract_protocol",
+    "load_protocol",
     "run_flow_rules",
+    "run_model_check",
     "summarize_file",
     "summarize_module",
     "summarize_source",

@@ -380,6 +380,14 @@ class StmtFact:
     attr_accesses: Tuple[AttrAccess, ...]
     spawns: Tuple[SpawnFact, ...]
     locks: FrozenSet[str]
+    # inside a try body/handler: the tolerant-read channel graftrdzv's G017
+    # checks (a protocol-file read outside any try cannot survive a torn
+    # or missing file)
+    in_try: bool = False
+    # f-string templates in this statement, constant parts verbatim and
+    # every interpolation collapsed to "\x00" — the protocol-file NAME
+    # channel (``f"ack_g{gen}.json"``) that `ast.literal_eval` cannot see
+    fstrings: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -1031,6 +1039,14 @@ class _FunctionLowerer:
                 for sf in self._tree_map_synthetics(c, in_loop)
             )
             ret = self._ret_fact(stmt) if isinstance(stmt, ast.Return) else None
+            in_try = any(
+                isinstance(p, ast.Try) for p in self._ancestors(stmt)
+            )
+            fstrings = tuple(
+                self._render_fstring(n)
+                for n in self._shallow_walk(stmt)
+                if isinstance(n, ast.JoinedStr)
+            )
             stmt_facts.append(
                 StmtFact(
                     line=stmt.lineno,
@@ -1043,6 +1059,8 @@ class _FunctionLowerer:
                     attr_accesses=tuple(self._attr_accesses(stmt, locks)),
                     spawns=tuple(self._spawns_in(calls)),
                     locks=locks,
+                    in_try=in_try,
+                    fstrings=fstrings,
                 )
             )
         return FunctionSummary(
@@ -1065,6 +1083,20 @@ class _FunctionLowerer:
         while cur is not None and cur is not self.fn:
             yield cur
             cur = self.parents.get(cur)
+
+    @staticmethod
+    def _render_fstring(node: ast.JoinedStr) -> str:
+        """Flatten an f-string to its constant skeleton, each interpolated
+        hole collapsed to "\\x00" — enough for graftrdzv to match
+        ``f"propose_g{gen}_r{rnd}_p{ident}.json"`` against the protocol
+        table's file patterns without evaluating anything."""
+        parts: List[str] = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("\x00")
+        return "".join(parts)
 
 
 def summarize_module(

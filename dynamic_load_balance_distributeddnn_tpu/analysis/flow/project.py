@@ -31,7 +31,7 @@ from dynamic_load_balance_distributeddnn_tpu.analysis.flow.ir import (
 
 # Bump whenever the IR schema or lowering semantics change: stale cache
 # entries must miss, not deserialize into wrong-shaped facts.
-IR_SCHEMA_VERSION = "gf5"
+IR_SCHEMA_VERSION = "gf6"
 
 
 def default_cache_dir() -> str:

@@ -1,0 +1,1294 @@
+"""graftrdzv: the rendezvous-protocol analysis layer (ISSUE 16).
+
+graftflow models data flow, graftmesh models device topology; this module
+models the one subsystem neither can see — the PR-14 elastic rendezvous
+protocol over the heartbeat-file directory (propose → agree → teardown →
+establish), which both ROADMAP headline items are about to rewrite. Four
+surfaces, one source of truth:
+
+* **Protocol table** — ``runtime/rendezvous.py`` declares its own automaton
+  as a pure-literal ``PROTOCOL`` dict (file kinds, phases, instants, the
+  engine recovery order). :func:`load_protocol` reads it with
+  ``ast.literal_eval`` — no runtime import, no jax — so the linter and the
+  trace tools interpret the SAME table the protocol code ships with.
+* **Extractor** (:func:`extract_protocol`) — lowers the rendezvous module's
+  IR (f-string skeletons, ``_write_json``/``open(..., "w")`` calls,
+  ``instant("rdzv_*")`` emissions) and cross-checks it against the table:
+  an undeclared protocol-file writer, a declared writer that no longer
+  writes, or a phantom instant is a mismatch, reported through G017.
+* **Model checker** (:class:`ProtocolModel`) — small-scope explicit-state
+  exploration of 2–3-process worlds with at most one crash or wedge
+  injected at every interleaving point and a torn-read branch on every
+  JSON read edge. Invariants: single generation winner, no
+  stale-generation adoption, torn/missing-file tolerance (deadlock
+  freedom), orbax barrier counters reset before any cross-process pairing,
+  every established world agrees on the roster, and loss-claim coherence
+  (no collective dispatched against a peer a published claim already names
+  dead). Seeded protocol mutations (:data:`MUTATIONS`) each trip an
+  invariant — the checker checks itself.
+* **Conformance replay** (:func:`check_conformance`) — replays recorded
+  spool ``rdzv_*`` instants against the automaton, so every real
+  postmortem from the chaos tests is validated as a legal protocol trace
+  (``graftscope conformance <dir>``).
+
+Lint rules G017 (protocol-file discipline), G018 (recovery phase order)
+and G019 (quiesce discipline on topology mutation) register into
+``flow.rules.FLOW_RULES`` and run on the same Project/CallGraph pair as
+G011–G016.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field, replace
+from typing import (
+    Dict,
+    FrozenSet,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.callgraph import (
+    CallGraph,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.ir import (
+    CallFact,
+    FunctionSummary,
+    ModuleSummary,
+    StmtFact,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.mesh import (
+    MESH_ATTRS,
+    reshard_surface,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import (
+    Project,
+)
+
+__all__ = [
+    "MUTATIONS",
+    "ProtocolModel",
+    "RuleG017",
+    "RuleG018",
+    "RuleG019",
+    "check_conformance",
+    "extract_protocol",
+    "load_protocol",
+    "run_model_check",
+]
+
+
+def _finding(code, path, line, col, message, fix_hint, symbol=""):
+    from dynamic_load_balance_distributeddnn_tpu.analysis.linter import Finding
+
+    return Finding(
+        code=code,
+        path=path,
+        line=line,
+        col=col,
+        message=message,
+        fix_hint=fix_hint,
+        symbol=symbol,
+    )
+
+
+def _guards_exclusive(
+    ga_t: Tuple[Tuple[int, str], ...], gb_t: Tuple[Tuple[int, str], ...]
+) -> bool:
+    ga, gb = dict(ga_t), dict(gb_t)
+    return any(ga[k] != gb[k] for k in ga.keys() & gb.keys())
+
+
+# --------------------------------------------------------------------------
+# Protocol table loading
+
+# Tokens that name the shared protocol directory, and the engine recovery
+# spine — module constants so the RULES need no file I/O; a unit test
+# asserts they stay equal to the shipped PROTOCOL table (the table is the
+# source of truth, these are its lint-side mirror).
+PROTO_DIR_TOKENS: FrozenSet[str] = frozenset(
+    {"rdzv_dir", "hb_dir", "heartbeat_dir"}
+)
+RECOVERY_ORDER: Dict[str, int] = {
+    "flush_checkpoints": 0,
+    "agree": 1,
+    "drain_collective_chain": 2,
+    "retire_runtime": 2,
+    "establish": 3,
+    "_reshard_world": 4,
+    "_state_from_host": 5,
+}
+RECOVERY_CORE: FrozenSet[str] = frozenset(
+    {"flush_checkpoints", "retire_runtime", "establish", "_reshard_world"}
+)
+
+_PROTOCOL_CACHE: Dict[str, Dict] = {}
+
+
+def rendezvous_source_path() -> str:
+    """The shipped ``runtime/rendezvous.py`` (table host) by package layout."""
+    flow_dir = os.path.dirname(os.path.abspath(__file__))
+    pkg = os.path.dirname(os.path.dirname(flow_dir))
+    return os.path.join(pkg, "runtime", "rendezvous.py")
+
+
+def load_protocol(path: Optional[str] = None) -> Dict:
+    """Parse the ``PROTOCOL`` literal out of ``rendezvous.py`` WITHOUT
+    importing it (the linter must stay jax-free). Cached per path."""
+    path = path or rendezvous_source_path()
+    key = os.path.abspath(path)
+    cached = _PROTOCOL_CACHE.get(key)
+    if cached is not None:
+        return cached
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "PROTOCOL":
+                    table = ast.literal_eval(node.value)
+                    if not isinstance(table, dict):
+                        raise ValueError(f"PROTOCOL in {path} is not a dict")
+                    _PROTOCOL_CACHE[key] = table
+                    return table
+    raise ValueError(f"no PROTOCOL table found in {path}")
+
+
+_HOLE = re.compile(r"\{[a-z_]+\}")
+
+
+def _pattern_regex(pattern: str) -> "re.Pattern":
+    """``ack_g{gen}.json`` -> a regex matching concrete file names."""
+    out: List[str] = []
+    pos = 0
+    for m in _HOLE.finditer(pattern):
+        out.append(re.escape(pattern[pos : m.start()]))
+        out.append(r"(\d+)")
+        pos = m.end()
+    out.append(re.escape(pattern[pos:]))
+    return re.compile("^" + "".join(out) + "$")
+
+
+def _pattern_skeleton(pattern: str) -> str:
+    """The pattern with every hole collapsed to the IR's f-string
+    wildcard, for matching against :attr:`StmtFact.fstrings`."""
+    return _HOLE.sub("\x00", pattern)
+
+
+def _pattern_glob(pattern: str) -> str:
+    return _HOLE.sub("*", pattern)
+
+
+def classify_protocol_file(name: str, protocol: Dict) -> Optional[str]:
+    """Protocol-file kind of a concrete directory entry, or None."""
+    base = os.path.basename(name)
+    for kind, info in protocol["files"].items():
+        if _pattern_regex(info["pattern"]).match(base):
+            return kind
+    return None
+
+
+# --------------------------------------------------------------------------
+# Extractor: cross-check the declared table against the module IR
+
+
+@dataclass
+class ProtoModel:
+    """What the IR says the protocol code actually does."""
+
+    protocol: Dict
+    # kind -> local qualnames observed writing that file kind
+    writers: Dict[str, Set[str]] = field(default_factory=dict)
+    # kind -> local qualnames observed reading/globbing that file kind
+    readers: Dict[str, Set[str]] = field(default_factory=dict)
+    # instant name -> local qualnames observed emitting it
+    instants: Dict[str, Set[str]] = field(default_factory=dict)
+    # (message, line) divergences between table and code
+    mismatches: List[Tuple[str, int]] = field(default_factory=list)
+
+
+def _fn_strings(fn: FunctionSummary) -> Iterator[Tuple[str, int]]:
+    """Every f-string skeleton and string literal in the function, with
+    its statement line — the protocol-file NAME channel."""
+    for stmt in fn.stmts:
+        for sk in stmt.fstrings:
+            yield sk, stmt.line
+        for call in stmt.calls:
+            for lit in call.lit_args:
+                if isinstance(lit, str):
+                    yield lit, call.line
+            for _, lit in call.lit_kwargs:
+                if isinstance(lit, str):
+                    yield lit, call.line
+
+
+def _fn_kinds(fn: FunctionSummary, protocol: Dict) -> Dict[str, int]:
+    """File kinds whose name pattern this function spells (exact literal,
+    f-string skeleton, or glob), kind -> first line."""
+    pats = {
+        kind: (
+            _pattern_regex(info["pattern"]),
+            _pattern_skeleton(info["pattern"]),
+            _pattern_glob(info["pattern"]),
+        )
+        for kind, info in protocol["files"].items()
+    }
+    out: Dict[str, int] = {}
+    for text, line in _fn_strings(fn):
+        base = os.path.basename(text)
+        for kind, (rx, skel, glob_pat) in pats.items():
+            if base == skel or base == glob_pat or rx.match(base):
+                out.setdefault(kind, line)
+    return out
+
+
+def _writes_files(fn: FunctionSummary, protocol: Dict) -> bool:
+    """The function performs a protocol-file WRITE: the atomic JSON helper,
+    or an ``open(..., "w")`` marker touch."""
+    writer = protocol.get("atomic_writer", "_write_json")
+    for stmt in fn.stmts:
+        for call in stmt.calls:
+            if call.tail == writer:
+                return True
+            if call.tail == "open" and any(
+                lit in ("w", "a") for lit in call.lit_args if isinstance(lit, str)
+            ):
+                return True
+    return False
+
+
+def extract_protocol(
+    project: Project, protocol: Optional[Dict] = None
+) -> Optional[ProtoModel]:
+    """Extract the automaton facts from the project's rendezvous module and
+    cross-check them against its declared ``PROTOCOL`` table. Returns None
+    when the project has no rendezvous module (fixture trees)."""
+    rdzv: Optional[ModuleSummary] = None
+    for mod in project.modules.values():
+        if mod.module.endswith("runtime.rendezvous"):
+            rdzv = mod
+            break
+    if rdzv is None:
+        return None
+    if protocol is None:
+        protocol = load_protocol(rdzv.path)
+    model = ProtoModel(protocol=protocol)
+    for fn in rdzv.functions.values():
+        kinds = _fn_kinds(fn, protocol)
+        if kinds:
+            bucket = (
+                model.writers if _writes_files(fn, protocol) else model.readers
+            )
+            for kind in kinds:
+                bucket.setdefault(kind, set()).add(fn.qualname)
+        for stmt in fn.stmts:
+            for call in stmt.calls:
+                # chained receivers (``get_tracer().instant(...)``) lower
+                # with an empty name/tail but keep their literal args; the
+                # cat="rdzv" kwarg separates protocol instants from
+                # recover-category spans behind the same receiver shape
+                if (
+                    call.lit_args
+                    and isinstance(call.lit_args[0], str)
+                    and call.lit_args[0].startswith("rdzv_")
+                    and (
+                        call.tail == "instant"
+                        or (
+                            call.tail == ""
+                            and ("cat", "rdzv") in call.lit_kwargs
+                        )
+                    )
+                ):
+                    model.instants.setdefault(call.lit_args[0], set()).add(
+                        fn.qualname
+                    )
+    # wipe helpers and directory sweepers name patterns but write nothing;
+    # only WRITER divergences are protocol hazards
+    for kind, info in protocol["files"].items():
+        declared = set(info["writers"])
+        observed = model.writers.get(kind, set())
+        for fqn in sorted(declared - set(rdzv.functions)):
+            model.mismatches.append(
+                (f"declared `{kind}` writer `{fqn}` does not exist", 1)
+            )
+        for fqn in sorted(declared & set(rdzv.functions)):
+            if fqn not in observed:
+                fn = rdzv.functions[fqn]
+                model.mismatches.append(
+                    (
+                        f"declared `{kind}` writer `{fqn}` never writes a "
+                        f"`{info['pattern']}` file",
+                        fn.line,
+                    )
+                )
+        for fqn in sorted(observed - declared):
+            fn = rdzv.functions[fqn]
+            model.mismatches.append(
+                (
+                    f"`{fqn}` writes protocol file kind `{kind}` but is not "
+                    "a declared writer in the PROTOCOL table",
+                    fn.line,
+                )
+            )
+    declared_instants = set(protocol.get("instants", ()))
+    observed_instants = set(model.instants)
+    for name in sorted(declared_instants - observed_instants):
+        model.mismatches.append(
+            (f"declared instant `{name}` is never emitted", 1)
+        )
+    for name in sorted(observed_instants - declared_instants):
+        line = min(
+            fn.line
+            for q in model.instants[name]
+            for fn in [rdzv.functions[q]]
+        )
+        model.mismatches.append(
+            (f"instant `{name}` emitted but not in the PROTOCOL table", line)
+        )
+    return model
+
+
+# --------------------------------------------------------------------------
+# Small-scope explicit-state model checker
+
+MUTATIONS: Tuple[str, ...] = (
+    "drop_reset_wipe",
+    "skip_orbax_reset",
+    "no_claim_adoption",
+    "establish_before_teardown",
+)
+
+_MAX_ROUNDS = 2  # proposal rounds per generation before the model aborts
+_GEN_HEADROOM = 3  # generations a scenario may advance past its start
+
+
+@dataclass(frozen=True)
+class _Proc:
+    """One process's protocol-visible state. ``status`` is the fault state
+    (live/crashed/wedged); ``phase`` the automaton position. ``paired`` is
+    the generation whose coordination service this process holds a client
+    of (-1 between teardown and establish); ``reset_gen`` the generation
+    the orbax barrier counters were last reset for."""
+
+    ident: int
+    phase: str  # running|agree|collect|teardown|barrier|lead|wait_ack|join|aborted
+    status: str  # live|crashed|wedged
+    gen: int
+    tgen: int  # in-flight target generation during a recovery
+    rnd: int
+    view: Tuple[int, ...]  # proposal view during agree/collect
+    roster: Tuple[int, ...]
+    paired: int
+    reset_gen: int
+
+
+# world state: (procs, files, fault_budget, legit_gens)
+_State = Tuple[Tuple[_Proc, ...], Tuple[Tuple[str, tuple], ...], int, Tuple[int, ...]]
+
+
+class ProtocolModel:
+    """Exhaustive small-scope exploration of the rendezvous protocol.
+
+    Scenarios start from an established world (the real bring-up is
+    sequential inside ``elastic_initialize``, so the interesting
+    interleavings all start after it): ``n_procs`` running at generation
+    ``start_gen``, optionally with stale previous-run files in the
+    directory (``stale=True``: the wipe either ran or — under the
+    ``drop_reset_wipe`` mutation — did not), optionally with one fresh
+    joiner. ``budget`` crash/wedge faults may be injected at any
+    interleaving point; every JSON read edge explores a torn/missing
+    branch. Mutations (:data:`MUTATIONS`) seed protocol bugs the
+    invariants must catch."""
+
+    def __init__(
+        self,
+        n_procs: int = 2,
+        *,
+        budget: int = 1,
+        stale: bool = False,
+        joiner: bool = False,
+        mutation: Optional[str] = None,
+        start_gen: int = 0,
+    ):
+        if mutation is not None and mutation not in MUTATIONS:
+            raise ValueError(f"unknown mutation {mutation!r}")
+        self.n = int(n_procs)
+        self.budget = int(budget)
+        self.stale = bool(stale)
+        self.joiner = bool(joiner)
+        self.mutation = mutation
+        self.start_gen = int(start_gen)
+        self.max_gen = self.start_gen + _GEN_HEADROOM
+        self.violations: Set[str] = set()
+        self.deadlocks: Set[_State] = set()
+        self.states_seen = 0
+
+    # ------------------------------------------------------------ file ops
+
+    @staticmethod
+    def _fdict(state: _State) -> Dict[str, tuple]:
+        return dict(state[1])
+
+    @staticmethod
+    def _freeze(files: Dict[str, tuple]) -> Tuple[Tuple[str, tuple], ...]:
+        return tuple(sorted(files.items()))
+
+    @staticmethod
+    def _disk_gen(files: Dict[str, tuple]) -> int:
+        gens = [0]
+        for name in files:
+            m = re.match(r"ack_g(\d+)\.json$", name)
+            if m:
+                gens.append(int(m.group(1)))
+        return max(gens)
+
+    @staticmethod
+    def _newest_ack(files: Dict[str, tuple]) -> Optional[Tuple[int, tuple]]:
+        best: Optional[Tuple[int, tuple]] = None
+        for name, payload in files.items():
+            m = re.match(r"ack_g(\d+)\.json$", name)
+            if m and (best is None or int(m.group(1)) > best[0]):
+                best = (int(m.group(1)), payload)
+        return best
+
+    @staticmethod
+    def _claims(files: Dict[str, tuple], gen: int, only_ident: Optional[int]) -> Set[int]:
+        out: Set[int] = set()
+        for name, payload in files.items():
+            m = re.match(rf"loss_g{gen}_p(\d+)\.json$", name)
+            if m is None:
+                continue
+            if only_ident is not None and int(m.group(1)) != only_ident:
+                continue
+            out.update(payload[0])
+        return out
+
+    # ----------------------------------------------------------- scenario
+
+    def initial(self) -> _State:
+        files: Dict[str, tuple] = {}
+        g0 = self.start_gen
+        members = list(range(self.n - 1 if self.joiner else self.n))
+        if self.stale:
+            # previous-run residue: a newer-generation ack naming this very
+            # fleet plus a ghost loss claim — exactly what a restarted fleet
+            # finds when the coordinator's wipe is dropped
+            sg = g0 + 2
+            files[f"ack_g{sg}.json"] = (tuple(members), 0)
+            files[f"loss_g{sg}_p0.json"] = (tuple(members[1:2]),)
+            if self.mutation != "drop_reset_wipe":
+                files = {}  # reset_rendezvous_dir: the coordinator wiped
+        files[f"ack_g{g0}.json"] = (tuple(members), 0)
+        procs = []
+        for i in range(self.n):
+            if self.joiner and i == self.n - 1:
+                procs.append(
+                    _Proc(i, "join", "live", 0, 0, 0, (), (), -1, 0)
+                )
+            else:
+                procs.append(
+                    _Proc(
+                        i, "running", "live", g0, g0, 0,
+                        (), tuple(members), g0, g0,
+                    )
+                )
+        return (tuple(procs), self._freeze(files), self.budget, (g0,))
+
+    # --------------------------------------------------------- exploration
+
+    def _viol(self, inv: str, msg: str) -> None:
+        self.violations.add(f"{inv}: {msg}")
+
+    def _enter_agree(
+        self,
+        p: _Proc,
+        view: Set[int],
+        files: Dict[str, tuple],
+    ) -> _Proc:
+        tgen = max(p.gen, self._disk_gen(files)) + 1
+        if tgen > self.max_gen:
+            return replace(p, phase="aborted")
+        return replace(
+            p,
+            phase="agree",
+            tgen=tgen,
+            rnd=0,
+            view=tuple(sorted(view | {p.ident})),
+        )
+
+    def _reagree(
+        self,
+        p: _Proc,
+        procs: Tuple[_Proc, ...],
+        files: Dict[str, tuple],
+        drop: Set[int],
+    ) -> _Proc:
+        """Timeout-claim path: a blocking phase timed out on a crashed
+        peer — publish the claim and re-run agree without it."""
+        dead = tuple(sorted(drop))
+        files[f"loss_g{p.gen}_p{p.ident}.json"] = (dead,)
+        return self._enter_agree(p, set(p.view or p.roster) - drop, files)
+
+    def _pair(
+        self,
+        p: _Proc,
+        procs: Tuple[_Proc, ...],
+        files: Dict[str, tuple],
+        roster: Tuple[int, ...],
+    ) -> _Proc:
+        """Connect to the generation-``tgen`` service: the cross-process
+        pairing step. All pairing invariants check HERE."""
+        reset_gen = p.reset_gen
+        if self.mutation != "skip_orbax_reset":
+            reset_gen = p.tgen  # _reset_orbax_barrier_counters()
+        if reset_gen != p.tgen:
+            self._viol(
+                "orbax-reset",
+                f"p{p.ident} paired at gen {p.tgen} with barrier counters "
+                f"last reset for gen {reset_gen}",
+            )
+        for q in procs:
+            if q.ident == p.ident or q.ident not in roster:
+                continue
+            if q.paired != -1 and q.paired < p.tgen:
+                self._viol(
+                    "teardown-barrier",
+                    f"p{p.ident} paired at gen {p.tgen} while roster member "
+                    f"p{q.ident} still holds the gen-{q.paired} client",
+                )
+            if q.status == "live" and q.paired == p.tgen and q.roster != roster:
+                self._viol(
+                    "roster-agreement",
+                    f"gen {p.tgen} established with divergent rosters "
+                    f"{roster} (p{p.ident}) vs {q.roster} (p{q.ident})",
+                )
+        if p.ident not in roster:
+            self._viol(
+                "roster-agreement",
+                f"p{p.ident} established gen {p.tgen} with a roster "
+                f"{roster} that does not contain itself",
+            )
+        files.pop(f"join_p{p.ident}.json", None)  # clear_join after joining
+        return replace(
+            p,
+            phase="running",
+            gen=p.tgen,
+            rnd=0,
+            view=(),
+            roster=roster,
+            paired=p.tgen,
+            reset_gen=reset_gen,
+        )
+
+    def _proc_steps(
+        self, state: _State, i: int
+    ) -> Iterator[Tuple[str, _State]]:
+        procs, _, budget, legit_t = state
+        p = procs[i]
+        if p.status != "live" or p.phase == "aborted":
+            return
+        legit = set(legit_t)
+        min_live = min(q.ident for q in procs if q.status == "live")
+
+        def emit(desc: str, np: _Proc, files: Dict[str, tuple], nlegit=None):
+            nprocs = tuple(
+                np if q.ident == p.ident else q for q in procs
+            )
+            yield_state = (
+                nprocs,
+                self._freeze(files),
+                budget,
+                tuple(sorted(nlegit if nlegit is not None else legit)),
+            )
+            return (f"p{p.ident}:{desc}", yield_state)
+
+        for reads_ok in (True, False):
+            files = self._fdict(state)
+            if p.phase == "running":
+                gen, roster = p.gen, set(p.roster)
+                nlegit = set(legit)
+                # boundary step 1: current_roster() generation adoption
+                newest = self._newest_ack(files) if reads_ok else None
+                if newest is not None and newest[0] > gen:
+                    if newest[0] not in legit:
+                        self._viol(
+                            "stale-adoption",
+                            f"p{p.ident} adopted generation {newest[0]} from "
+                            "a directory ack no live process established "
+                            "this run (dropped reset_rendezvous_dir wipe)",
+                        )
+                        nlegit.add(newest[0])  # keep exploring past it
+                    gen, roster = newest[0], set(newest[1][0])
+                # boundary step 2: loss-claim adoption + own beacon scan
+                only = p.ident if self.mutation == "no_claim_adoption" else None
+                claims = self._claims(files, gen, only) if reads_ok else set()
+                scan = (
+                    {q.ident for q in procs if q.status == "crashed"}
+                    if p.ident == min_live
+                    else set()
+                )
+                if p.ident in claims:
+                    # a claim names ME dead: agree would evict this process
+                    yield emit("evicted", replace(p, phase="aborted"), files, nlegit)
+                    continue
+                dead = (claims | scan) & roster
+                joins = set()
+                if reads_ok:
+                    for name in files:
+                        m = re.match(r"join_p(\d+)\.json$", name)
+                        if m and int(m.group(1)) not in roster:
+                            joins.add(int(m.group(1)))
+                if dead:
+                    files[f"loss_g{gen}_p{p.ident}.json"] = (
+                        tuple(sorted(dead)),
+                    )
+                    np = replace(p, gen=gen, roster=tuple(sorted(roster)))
+                    np = self._enter_agree(np, (roster - dead) | joins, files)
+                    yield emit("recover", np, files, nlegit)
+                elif joins:
+                    np = replace(p, gen=gen, roster=tuple(sorted(roster)))
+                    np = self._enter_agree(np, roster | joins, files)
+                    yield emit("admit", np, files, nlegit)
+                else:
+                    # dispatch the next window's collectives over the roster
+                    all_claims = self._claims(files, gen, None)
+                    ghosts = {
+                        q.ident
+                        for q in procs
+                        if q.status == "crashed" and q.ident in roster
+                    }
+                    if reads_ok and ghosts & all_claims:
+                        self._viol(
+                            "claim-coherence",
+                            f"p{p.ident} dispatched a collective over roster "
+                            f"{tuple(sorted(roster))} although a published "
+                            f"loss claim already names {sorted(ghosts & all_claims)} "
+                            "dead (loss-claim adoption dropped)",
+                        )
+                    np = replace(p, gen=gen, roster=tuple(sorted(roster)))
+                    yield emit("dispatch", np, files, nlegit)
+
+            elif p.phase == "agree":
+                files[f"propose_g{p.tgen}_r{p.rnd}_p{p.ident}.json"] = p.view
+                yield emit("propose", replace(p, phase="collect"), files)
+
+            elif p.phase == "collect":
+                present: Dict[int, tuple] = {}
+                if reads_ok:
+                    for q in p.view:
+                        payload = files.get(
+                            f"propose_g{p.tgen}_r{p.rnd}_p{q}.json"
+                        )
+                        if payload is not None:
+                            present[q] = payload
+                missing = [q for q in p.view if q not in present]
+                if not missing:
+                    if len(set(present.values())) == 1:
+                        roster = tuple(sorted(next(iter(present.values()))))
+                        np = replace(p, roster=roster)
+                        if self.mutation == "establish_before_teardown":
+                            # reorder bug: skip the torn write AND barrier —
+                            # establish while peers still hold old clients
+                            np = replace(
+                                np,
+                                phase=(
+                                    "lead"
+                                    if p.ident == min(roster)
+                                    else "wait_ack"
+                                ),
+                            )
+                        else:
+                            np = replace(np, phase="teardown")
+                        yield emit("agreed", np, files)
+                    else:
+                        merged = set(p.view)
+                        for v in present.values():
+                            merged &= set(v)
+                        merged -= {
+                            q.ident for q in procs if q.status == "crashed"
+                        }
+                        merged |= {p.ident}
+                        if p.rnd + 1 > _MAX_ROUNDS:
+                            yield emit(
+                                "rounds-exhausted",
+                                replace(p, phase="aborted"),
+                                files,
+                            )
+                        else:
+                            yield emit(
+                                "advance",
+                                replace(
+                                    p,
+                                    phase="agree",
+                                    rnd=p.rnd + 1,
+                                    view=tuple(sorted(merged)),
+                                ),
+                                files,
+                            )
+                else:
+                    blockers = [
+                        q
+                        for q in procs
+                        if q.ident in missing
+                        and (q.status != "live" or q.phase == "aborted")
+                    ]
+                    crashed = {q.ident for q in blockers if q.status == "crashed"}
+                    if crashed:
+                        yield emit(
+                            "timeout-claim",
+                            self._reagree(p, procs, files, crashed),
+                            files,
+                        )
+                    else:
+                        # wedged/aborted peer — or a live peer that has
+                        # diverged to another round/generation and will
+                        # never answer this one: the _wait deadline fires
+                        # RendezvousTimeout and the engine degrades to
+                        # abort-and-resume. (For live peers this branch
+                        # coexists with plain waiting: their own steps
+                        # also progress the state.)
+                        yield emit(
+                            "timeout-abort", replace(p, phase="aborted"), files
+                        )
+
+            elif p.phase == "teardown":
+                files[f"torn_g{p.tgen}_p{p.ident}"] = ()
+                yield emit(
+                    "torn", replace(p, phase="barrier", paired=-1), files
+                )
+
+            elif p.phase == "barrier":
+                missing = [
+                    q
+                    for q in p.roster
+                    if f"torn_g{p.tgen}_p{q}" not in files
+                ]
+                if not missing:
+                    np = replace(
+                        p,
+                        phase="lead" if p.ident == min(p.roster) else "wait_ack",
+                    )
+                    yield emit("barrier-pass", np, files)
+                else:
+                    blockers = [
+                        q
+                        for q in procs
+                        if q.ident in missing
+                        and (q.status != "live" or q.phase == "aborted")
+                    ]
+                    crashed = {q.ident for q in blockers if q.status == "crashed"}
+                    if crashed:
+                        yield emit(
+                            "barrier-timeout-claim",
+                            self._reagree(p, procs, files, crashed),
+                            files,
+                        )
+                    else:
+                        # wedged peer, or a live peer that re-agreed past
+                        # this barrier: deadline -> abort (see collect)
+                        yield emit(
+                            "barrier-timeout",
+                            replace(p, phase="aborted"),
+                            files,
+                        )
+
+            elif p.phase == "lead":
+                name = f"ack_g{p.tgen}.json"
+                payload = (p.roster, p.ident)
+                if name in files and files[name] != payload:
+                    self._viol(
+                        "single-winner",
+                        f"two coordinators published ack_g{p.tgen}: "
+                        f"{files[name]} vs {payload}",
+                    )
+                files[name] = payload
+                np = self._pair(p, procs, files, p.roster)
+                yield emit("establish", np, files, legit | {p.tgen})
+
+            elif p.phase == "wait_ack":
+                leader = min(p.roster)
+                lead_p = procs[leader]
+                ack = files.get(f"ack_g{p.tgen}.json") if reads_ok else None
+                if ack is not None:
+                    if lead_p.status == "crashed":
+                        # service owner died after publishing: connect fails
+                        yield emit(
+                            "connect-fail-claim",
+                            self._reagree(p, procs, files, {leader}),
+                            files,
+                        )
+                    else:
+                        np = self._pair(
+                            p, procs, files, tuple(sorted(ack[0]))
+                        )
+                        yield emit("connect", np, files)
+                else:
+                    if lead_p.status == "crashed":
+                        yield emit(
+                            "ack-timeout-claim",
+                            self._reagree(p, procs, files, {leader}),
+                            files,
+                        )
+                    else:
+                        # leader wedged/aborted/diverged: deadline -> abort
+                        yield emit(
+                            "ack-timeout", replace(p, phase="aborted"), files
+                        )
+
+            elif p.phase == "join":
+                newest = self._newest_ack(files) if reads_ok else None
+                if newest is None:
+                    continue  # nothing to join yet (or torn read): retry
+                gen, (roster, _addr) = newest[0], newest[1]
+                if gen not in legit:
+                    self._viol(
+                        "stale-adoption",
+                        f"joining p{p.ident} adopted unestablished "
+                        f"generation {gen}",
+                    )
+                files[f"join_p{p.ident}.json"] = ()
+                np = replace(p, gen=gen, roster=tuple(sorted(roster)))
+                np = self._enter_agree(np, set(roster), files)
+                yield emit("offer-join", np, files)
+
+    def successors(
+        self, state: _State
+    ) -> Tuple[List[Tuple[str, _State]], List[Tuple[str, _State]]]:
+        """(protocol steps, fault injections). Separated so deadlock
+        detection can ignore the fault budget."""
+        steps: List[Tuple[str, _State]] = []
+        for i in range(self.n):
+            steps.extend(self._proc_steps(state, i))
+        faults: List[Tuple[str, _State]] = []
+        procs, files, budget, legit = state
+        if budget > 0:
+            for i, p in enumerate(procs):
+                if p.status != "live" or p.phase == "aborted":
+                    continue
+                for status in ("crashed", "wedged"):
+                    nprocs = tuple(
+                        replace(q, status=status) if q.ident == p.ident else q
+                        for q in procs
+                    )
+                    faults.append(
+                        (f"p{p.ident}:{status}", (nprocs, files, budget - 1, legit))
+                    )
+        return steps, faults
+
+    def run(self, max_states: int = 400_000) -> Dict:
+        """BFS over the full interleaving space. Returns violation/deadlock
+        summaries; raises if the scope bound explodes (a model bug)."""
+        init = self.initial()
+        frontier = [init]
+        visited = {init}
+        while frontier:
+            nxt: List[_State] = []
+            for state in frontier:
+                steps, faults = self.successors(state)
+                live_waiting = any(
+                    p.status == "live"
+                    and p.phase not in ("running", "aborted")
+                    for p in state[0]
+                )
+                if not steps and live_waiting:
+                    self.deadlocks.add(state)
+                    self._viol(
+                        "torn-tolerance",
+                        "deadlock: a live process is blocked in phase "
+                        + ",".join(
+                            f"p{p.ident}={p.phase}"
+                            for p in state[0]
+                            if p.status == "live" and p.phase != "running"
+                        ),
+                    )
+                for _, ns in steps + faults:
+                    if ns not in visited:
+                        visited.add(ns)
+                        nxt.append(ns)
+            if len(visited) > max_states:
+                raise RuntimeError(
+                    f"model scope blew past {max_states} states"
+                )
+            frontier = nxt
+        self.states_seen = len(visited)
+        return {
+            "states": self.states_seen,
+            "violations": sorted(self.violations),
+            "deadlocks": len(self.deadlocks),
+        }
+
+
+def run_model_check(
+    n_procs: int = 2,
+    *,
+    budget: int = 1,
+    stale: bool = False,
+    joiner: bool = False,
+    mutation: Optional[str] = None,
+    max_states: int = 400_000,
+) -> Dict:
+    """One scenario, one result dict — the test-facing entry point."""
+    model = ProtocolModel(
+        n_procs,
+        budget=budget,
+        stale=stale,
+        joiner=joiner,
+        mutation=mutation,
+    )
+    return model.run(max_states=max_states)
+
+
+# --------------------------------------------------------------------------
+# Dynamic conformance: replay recorded instants against the automaton
+
+
+def check_conformance(
+    events: Sequence[Dict], protocol: Optional[Dict] = None
+) -> Tuple[List[str], Dict]:
+    """Validate a merged chrome-event stream (``scope_cli._merge_sources``
+    output) as a legal protocol trace. Per process: ``rdzv_agreed(g)`` <
+    ``rdzv_torn(g)`` < ``rdzv_established(g)``, established generations
+    strictly increase; across processes: every establishment of the same
+    generation agrees on roster and coordinator address. Unknown instants
+    and ``rdzv_timeout`` are tolerated anywhere (timeouts are legal
+    degradations, not protocol violations)."""
+    if protocol is None:
+        protocol = load_protocol()
+    violations: List[str] = []
+    agreed: Dict[int, Set[int]] = {}
+    torn: Dict[int, Set[int]] = {}
+    last_est: Dict[int, int] = {}
+    est_info: Dict[int, Tuple[tuple, str]] = {}
+    counts: Dict[str, int] = {}
+    instants = [
+        e
+        for e in events
+        if e.get("ph") == "i" and str(e.get("name", "")).startswith(("rdzv_", "health_"))
+    ]
+    instants.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0)))
+    for ev in instants:
+        name = ev.get("name")
+        pid = int(ev.get("pid", 0))
+        args = ev.get("args") or {}
+        counts[name] = counts.get(name, 0) + 1
+        gen = args.get("gen")
+        if name == "rdzv_init":
+            last_est[pid] = max(last_est.get(pid, -1), 0)
+        elif name == "rdzv_agreed" and gen is not None:
+            g = int(gen)
+            if g <= last_est.get(pid, -1):
+                violations.append(
+                    f"pid {pid}: agreed at generation {g} but already "
+                    f"established generation {last_est[pid]}"
+                )
+            agreed.setdefault(pid, set()).add(g)
+        elif name == "rdzv_torn" and gen is not None:
+            g = int(gen)
+            if g not in agreed.get(pid, set()):
+                violations.append(
+                    f"pid {pid}: tore down for generation {g} with no "
+                    "prior agreement"
+                )
+            torn.setdefault(pid, set()).add(g)
+        elif name == "rdzv_established" and gen is not None:
+            g = int(gen)
+            if g > 0 and g not in torn.get(pid, set()):
+                violations.append(
+                    f"pid {pid}: established generation {g} without "
+                    "passing the teardown barrier"
+                )
+            if g <= last_est.get(pid, -1):
+                violations.append(
+                    f"pid {pid}: established generation {g} after "
+                    f"generation {last_est[pid]} — generations must be "
+                    "strictly increasing"
+                )
+            last_est[pid] = max(last_est.get(pid, -1), g)
+            roster = tuple(args.get("roster", ()))
+            address = str(args.get("address", ""))
+            prior = est_info.get(g)
+            if prior is not None and prior != (roster, address):
+                violations.append(
+                    f"generation {g} established twice with divergent "
+                    f"worlds: {prior} vs {(roster, address)}"
+                )
+            est_info.setdefault(g, (roster, address))
+    stats = {
+        "events": len(instants),
+        "processes": sorted({int(e.get("pid", 0)) for e in instants}),
+        "generations": sorted(est_info),
+        "counts": counts,
+    }
+    return violations, stats
+
+
+# --------------------------------------------------------------------------
+# G017 — protocol-file discipline
+
+
+class RuleG017:
+    code = "G017"
+    summary = (
+        "protocol-file access bypasses the atomic-write/tolerant-read "
+        "discipline (raw json.dump to a rendezvous/heartbeat path, or an "
+        "unguarded read that a torn file would crash)"
+    )
+    fix_hint = (
+        "write protocol files through the tmp+os.replace helper "
+        "(rendezvous._write_json) and wrap every protocol read in "
+        "try/except that treats a missing or torn file as absent"
+    )
+
+    _WRITE_TAILS = frozenset({"dump", "write_text"})
+    _READ_TAILS = frozenset({"load", "read_text"})
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        for fn in ctx.project.functions.values():
+            yield from self._check_fn(ctx, fn)
+        model = extract_protocol(ctx.project)
+        if model is not None:
+            rdzv = next(
+                m
+                for m in ctx.project.modules.values()
+                if m.module.endswith("runtime.rendezvous")
+            )
+            for msg, line in model.mismatches:
+                if self.code in rdzv.suppressions.get(line, frozenset()):
+                    continue
+                yield _finding(
+                    self.code,
+                    rdzv.path,
+                    line,
+                    0,
+                    f"PROTOCOL table out of sync with the code: {msg}",
+                    "update the PROTOCOL literal in runtime/rendezvous.py "
+                    "to match the writers/instants the code actually has",
+                    symbol=f"{rdzv.module}::PROTOCOL",
+                )
+
+    def _check_fn(self, ctx, fn: FunctionSummary) -> Iterator["Finding"]:
+        tainted: Set[str] = set(PROTO_DIR_TOKENS)
+        mentions_dir = False
+        has_replace = False
+        for stmt in fn.stmts:
+            for tok, _, _ in stmt.reads:
+                if set(tok.split(".")) & PROTO_DIR_TOKENS:
+                    mentions_dir = True
+            if stmt.bind is not None:
+                # with-item binds (``with open(join(hb_dir, ...)) as f``)
+                # carry empty rhs_idents: the rhs is the call itself, so
+                # taint also flows through the same-statement call args
+                rhs = set(stmt.bind.rhs_idents)
+                for call in stmt.calls:
+                    for ai in call.arg_idents:
+                        rhs |= ai
+                if rhs & tainted:
+                    for tgt in stmt.bind.targets:
+                        tainted.add(tgt.rsplit(".", 1)[-1])
+            for call in stmt.calls:
+                if call.tail == "replace":
+                    has_replace = True
+                for idents in call.arg_idents:
+                    if idents & PROTO_DIR_TOKENS:
+                        mentions_dir = True
+        if not mentions_dir:
+            return
+        for stmt in fn.stmts:
+            for call in stmt.calls:
+                idents: Set[str] = set()
+                for ai in call.arg_idents:
+                    idents |= ai
+                for _, ki in call.kwarg_idents:
+                    idents |= ki
+                recv = call.name.rsplit(".", 1)[0] if "." in call.name else ""
+                involved = bool(idents & tainted) or recv in tainted
+                if not involved:
+                    continue
+                if ctx.suppressed(fn, self.code, call.line):
+                    continue
+                if call.tail in self._WRITE_TAILS and not has_replace:
+                    yield _finding(
+                        self.code,
+                        ctx.path_of(fn),
+                        call.line,
+                        call.col,
+                        f"`{call.tail}` writes into the protocol directory "
+                        "without the tmp+os.replace discipline — a reader "
+                        "racing this write sees a torn file",
+                        self.fix_hint,
+                        symbol=f"{fn.module}::{fn.qualname}",
+                    )
+                elif call.tail in self._READ_TAILS and not stmt.in_try:
+                    yield _finding(
+                        self.code,
+                        ctx.path_of(fn),
+                        call.line,
+                        call.col,
+                        f"`{call.tail}` reads a protocol file outside any "
+                        "try — a missing or torn file (legal at every "
+                        "point of the protocol) crashes this reader",
+                        self.fix_hint,
+                        symbol=f"{fn.module}::{fn.qualname}",
+                    )
+
+
+# --------------------------------------------------------------------------
+# G018 — recovery phase-order conformance
+
+
+class RuleG018:
+    code = "G018"
+    summary = (
+        "recovery path calls rendezvous phases out of automaton order "
+        "(flush -> agree -> drain/retire -> establish -> reshard -> restore)"
+    )
+    fix_hint = (
+        "reorder the recovery sequence to match the extracted automaton: "
+        "checkpoints flush first, the old runtime retires before establish, "
+        "and the world reshards only after the new world is established"
+    )
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        for fn in ctx.project.functions.values():
+            yield from self._check_fn(ctx, fn)
+
+    @staticmethod
+    def _occurrences(
+        fn: FunctionSummary,
+    ) -> List[Tuple[int, StmtFact, CallFact, str]]:
+        out: List[Tuple[int, StmtFact, CallFact, str]] = []
+        for stmt in fn.stmts:
+            for call in stmt.calls:
+                phase = RECOVERY_ORDER.get(call.tail)
+                tail = call.tail
+                if phase is None:
+                    # `retry_transient(lambda: self._reshard_world(...))`:
+                    # the phase callee hides inside the wrapper's argument
+                    wrapped = sorted(
+                        t
+                        for idents in call.arg_idents
+                        for t in idents & set(RECOVERY_ORDER)
+                    )
+                    if not wrapped:
+                        continue
+                    tail = wrapped[0]
+                    phase = RECOVERY_ORDER[tail]
+                out.append((phase, stmt, call, tail))
+        return out
+
+    def _check_fn(self, ctx, fn: FunctionSummary) -> Iterator["Finding"]:
+        occs = self._occurrences(fn)
+        phases = {ph for ph, _, _, _ in occs}
+        tails = {t for _, _, _, t in occs}
+        if len(phases) < 2 or not (tails & RECOVERY_CORE):
+            return
+        occs.sort(key=lambda o: (o[2].line, o[2].col))
+        rets = [
+            stmt for stmt in fn.stmts if stmt.ret is not None
+        ]
+        max_ph, max_stmt, max_tail = -1, None, ""
+        for ph, stmt, call, tail in occs:
+            if max_stmt is not None and any(
+                max_stmt.line <= r.line <= call.line
+                and set(r.guards) <= set(max_stmt.guards)
+                for r in rets
+            ):
+                # every path through the prior max-phase call returns
+                # before this statement: a fresh recovery sequence, not
+                # a continuation of the previous one
+                max_ph, max_stmt, max_tail = -1, None, ""
+            if ph < max_ph and max_stmt is not None:
+                if _guards_exclusive(stmt.guards, max_stmt.guards):
+                    continue
+                if ctx.suppressed(fn, self.code, call.line):
+                    continue
+                yield _finding(
+                    self.code,
+                    ctx.path_of(fn),
+                    call.line,
+                    call.col,
+                    f"`{tail}` (recovery phase {ph}) runs after "
+                    f"`{max_tail}` (phase {max_ph}) — the extracted "
+                    "rendezvous automaton orders "
+                    "flush -> agree -> drain/retire -> establish -> "
+                    "reshard -> restore",
+                    self.fix_hint,
+                    symbol=f"{fn.module}::{fn.qualname}",
+                )
+            elif ph >= max_ph:
+                max_ph, max_stmt, max_tail = ph, stmt, tail
+
+
+# --------------------------------------------------------------------------
+# G019 — quiesce discipline on topology mutation
+
+
+class RuleG019:
+    code = "G019"
+    summary = (
+        "topology mutation without quiesce: a mesh/world rebuild runs with "
+        "no lock held and no drain/quiesce step while package threads exist"
+    )
+    fix_hint = (
+        "drain or quiesce the concurrent consumers (pipeline threads, "
+        "flushers) before rebuilding the mesh — call a *quiesce*/*drain* "
+        "helper first or hold the lock those threads observe"
+    )
+
+    _MARKERS = ("quiesce", "drain")
+
+    def check(self, ctx) -> Iterator["Finding"]:
+        thread_side, _ = ctx.graph.thread_sides()
+        if not thread_side:
+            return  # no package threads: program order IS the discipline
+        surface = getattr(ctx, "_reshard_surface", None)
+        if surface is None:
+            surface = reshard_surface(ctx.project, ctx.graph)
+            ctx._reshard_surface = surface
+        mutators, _ = surface
+        for fqn in sorted(mutators):
+            fn = ctx.project.functions.get(fqn)
+            if fn is None:
+                continue
+            writes = [
+                (stmt, acc)
+                for stmt in fn.stmts
+                for acc in stmt.attr_accesses
+                if acc.write and acc.attr in MESH_ATTRS
+            ]
+            if not writes:
+                continue
+            if all(acc.locks for _, acc in writes):
+                continue  # locked: G012's discipline covers it
+            if ctx.graph.lock_env.get(fqn):
+                continue  # every caller holds a lock around the call
+            first = min(writes, key=lambda w: (w[1].line, w[1].col))
+            quiesced = any(
+                any(m in call.tail.lower() for m in self._MARKERS)
+                and (call.line, call.col) <= (first[1].line, first[1].col)
+                for stmt in fn.stmts
+                for call in stmt.calls
+            )
+            if quiesced:
+                continue
+            if ctx.suppressed(fn, self.code, first[1].line):
+                continue
+            yield _finding(
+                self.code,
+                ctx.path_of(fn),
+                first[1].line,
+                first[1].col,
+                f"`{fn.qualname}` rebuilds `self.{first[1].attr}` with no "
+                "lock held and no preceding quiesce/drain step, while "
+                "package threads run concurrently — \"synchronized by "
+                "program order\" must be made checkable before the "
+                "many-stream scheduler multiplies the concurrent users",
+                self.fix_hint,
+                symbol=f"{fn.module}::{fn.qualname}",
+            )

@@ -25,7 +25,9 @@ All three run on the :class:`~.project.Project` + :class:`~.callgraph.CallGraph`
 pair — no ASTs, only summaries — so the whole-program pass stays cacheable
 and cheap (tests/test_graftflow.py budgets the full-repo run). The
 graftmesh families G014-G016 (flow/mesh.py) register into FLOW_RULES below
-and run on the same pair, with a shared per-run :class:`~.mesh.MeshModel`.
+and run on the same pair, with a shared per-run :class:`~.mesh.MeshModel`,
+as do the graftrdzv protocol rules G017-G019 (flow/proto.py) checking the
+rendezvous file/phase/quiesce discipline against the extracted automaton.
 """
 
 from __future__ import annotations
@@ -48,6 +50,11 @@ from dynamic_load_balance_distributeddnn_tpu.analysis.flow.mesh import (
     reshard_surface,
 )
 from dynamic_load_balance_distributeddnn_tpu.analysis.flow.project import Project
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.proto import (
+    RuleG017,
+    RuleG018,
+    RuleG019,
+)
 
 
 def _finding(code, path, line, col, message, fix_hint, symbol=""):
@@ -733,6 +740,9 @@ FLOW_RULES: Dict[str, object] = {
         RuleG014(),
         RuleG015(),
         RuleG016(),
+        RuleG017(),
+        RuleG018(),
+        RuleG019(),
     )
 }
 

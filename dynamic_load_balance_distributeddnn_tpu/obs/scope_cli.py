@@ -11,6 +11,7 @@ Usage::
     graftscope merge run.trace.json -o merged.json        # + worker traces
     graftscope postmortem spools/                         # crash stitcher
     graftscope decisions traces/run.trace.json            # DBS journal
+    graftscope conformance spools/                        # protocol replay
 
 ``summarize`` and ``merge`` automatically stitch compile-worker trace files
 (``compile_worker_*.trace.json``, written per process by the AOT service's
@@ -28,7 +29,17 @@ per process). ``decisions`` renders the online-DBS controller's decision
 journal (every switch/hold verdict with the inputs it was decided on) from
 a trace or spool, so "why did epoch 7 rebalance?" is answerable offline.
 
-Exit status: 0 on success, 2 on usage/IO errors.
+``conformance`` (ISSUE 16, graftrdzv) replays the recorded ``rdzv_*``
+instants of every spool/trace under a directory against the rendezvous
+PROTOCOL automaton (analysis/flow/proto.py): per process agreed(g) must
+precede torn(g) must precede established(g) with strictly increasing
+established generations, and across processes every establishment of one
+generation must agree on roster and coordinator — so each real chaos-test
+postmortem doubles as a checked protocol trace.
+
+Exit status: 0 on success, 1 when ``conformance`` finds protocol
+violations, 2 on usage/IO errors (including an empty or missing spool
+directory).
 """
 
 from __future__ import annotations
@@ -543,6 +554,11 @@ def _decision_events(path: str) -> List[dict]:
     of spools — the controller journal's offline surface."""
     if os.path.isdir(path) or path.endswith(".spool"):
         sources, _ = _gather_sources(path)
+        if not sources:
+            # an empty/missing spool dir used to render the friendly
+            # "no decision events" note and exit 0 — masking a wrong path
+            # in CI scripts; no evidence at all is an error, not a journal
+            raise ValueError(f"no readable spool/trace files under {path}")
         events, _ = _merge_sources(sources)
     else:
         events = load_trace(path)
@@ -596,6 +612,67 @@ def decisions(path: str, as_json: bool = False) -> str:
     )
 
 
+# ------------------------------------------------------------ conformance
+
+
+def conformance(dir_or_file: str, as_json: bool = False) -> "tuple[str, bool]":
+    """Replay every recorded ``rdzv_*`` instant under ``dir_or_file``
+    against the rendezvous PROTOCOL automaton. Returns ``(rendered, ok)``;
+    the CLI maps ``ok=False`` to exit status 1 so the chaos harness can
+    gate on it."""
+    from dynamic_load_balance_distributeddnn_tpu.analysis.flow.proto import (
+        check_conformance,
+    )
+
+    sources, skipped = _gather_sources(dir_or_file)
+    if not sources:
+        raise ValueError(f"no readable spool/trace files under {dir_or_file}")
+    merged, _base0 = _merge_sources(sources)
+    violations, stats = check_conformance(merged)
+    ok = not violations
+    if as_json:
+        return (
+            json.dumps(
+                {
+                    "ok": ok,
+                    "violations": violations,
+                    "stats": stats,
+                    "skipped": skipped,
+                }
+            ),
+            ok,
+        )
+    lines: List[str] = []
+    if stats["events"] == 0:
+        # sources existed but none carried protocol instants: report it
+        # rather than calling silence conformant-looking
+        lines.append(
+            "conformance: no rdzv_* instants recorded under "
+            f"{dir_or_file} (nothing to validate)"
+        )
+        return "\n".join(lines), ok
+    for v in violations:
+        lines.append(f"VIOLATION: {v}")
+    verdict = "OK" if ok else f"{len(violations)} violation(s)"
+    gens = ", ".join(str(g) for g in stats["generations"]) or "-"
+    procs = ", ".join(str(p) for p in stats["processes"])
+    lines.append(
+        f"conformance: {verdict} — {stats['events']} protocol event(s) "
+        f"across process(es) [{procs}], established generation(s) [{gens}]"
+    )
+    counts = ", ".join(
+        f"{name}×{n}" for name, n in sorted(stats["counts"].items())
+    )
+    if counts:
+        lines.append(f"  instants: {counts}")
+    if skipped:
+        lines.append(
+            f"  skipped {len(skipped)} unreadable file(s): "
+            + ", ".join(skipped)
+        )
+    return "\n".join(lines), ok
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="graftscope",
@@ -644,6 +721,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     dc.add_argument("path")
     dc.add_argument("--json", action="store_true")
+    cf = sub.add_parser(
+        "conformance",
+        help="replay recorded rdzv_* instants against the rendezvous "
+        "PROTOCOL automaton (exit 1 on protocol violations) — every "
+        "chaos-test spool directory doubles as a checked protocol trace",
+    )
+    cf.add_argument("dir", help="directory of spools/traces (or one file)")
+    cf.add_argument("--json", action="store_true")
     return p
 
 
@@ -667,6 +752,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(postmortem(args.dir, out=args.out, as_json=args.json))
         elif args.cmd == "decisions":
             print(decisions(args.path, as_json=args.json))
+        elif args.cmd == "conformance":
+            text, ok = conformance(args.dir, as_json=args.json)
+            print(text)
+            if not ok:
+                return 1
         else:
             print(diff(args.trace_a, args.trace_b, as_json=args.json))
     except (OSError, ValueError, KeyError) as exc:

@@ -120,6 +120,111 @@ class Agreement:
         return self.rank == 0
 
 
+# --------------------------------------------------------------------------
+# Machine-readable protocol annotation (graftrdzv, ISSUE 16).
+#
+# This table IS the rendezvous automaton, declared next to the code that
+# implements it. It must stay a PURE literal: `analysis/flow/proto.py`
+# loads it with `ast.literal_eval` (no runtime import, no jax), extracts
+# the same facts from the IR, and cross-checks the two — a writer added
+# below without a row here (or vice versa) is a lint finding, not a code
+# review hope. The small-scope model checker and the `graftscope
+# conformance` replay both interpret this table, so the file-name
+# patterns, phases and instants below are load-bearing, not documentation.
+#
+# File-name patterns use `{hole}` for interpolated fields; `proto.py`
+# matches them against both the IR's f-string skeletons and real
+# directory listings / trace payloads.
+PROTOCOL = {
+    "version": 1,
+    # attribute/parameter tokens that name the shared protocol directory
+    "dir_tokens": ("rdzv_dir", "hb_dir", "heartbeat_dir"),
+    # every JSON protocol write goes through this atomic tmp+replace
+    # helper; every JSON protocol read through this tolerant reader
+    "atomic_writer": "_write_json",
+    "tolerant_reader": "_read_json",
+    # stale-state wipe at gen-0 bring-up (coordinator only, BEFORE ack_g0)
+    "wipe": "reset_rendezvous_dir",
+    # protocol files: name pattern, payload format, sanctioned writers
+    # (qualnames local to this module), and what readers must tolerate
+    "files": {
+        "ack": {
+            "pattern": "ack_g{gen}.json",
+            "format": "json",
+            "writers": ("elastic_initialize", "RendezvousStateMachine.establish"),
+            "tolerate": "missing-or-torn",
+        },
+        "propose": {
+            "pattern": "propose_g{gen}_r{rnd}_p{ident}.json",
+            "format": "json",
+            "writers": ("RendezvousStateMachine.agree",),
+            "tolerate": "missing-or-torn",
+        },
+        "torn": {
+            "pattern": "torn_g{gen}_p{ident}",
+            "format": "marker",
+            "writers": ("RendezvousStateMachine.establish",),
+            "tolerate": "missing",
+        },
+        "loss": {
+            "pattern": "loss_g{gen}_p{ident}.json",
+            "format": "json",
+            "writers": ("RendezvousStateMachine.claim_loss",),
+            "tolerate": "missing-or-torn",
+        },
+        "join": {
+            "pattern": "join_p{ident}.json",
+            "format": "json",
+            "writers": ("RendezvousStateMachine.offer_join",),
+            "tolerate": "missing-or-torn",
+        },
+        "done": {
+            "pattern": "done_p{ident}",
+            "format": "marker",
+            "writers": ("RendezvousStateMachine.finalize",),
+            "tolerate": "missing",
+        },
+    },
+    # per-process phase automaton; a recovery walks these edges in order
+    "phases": ("running", "agree", "teardown", "establish", "established"),
+    "edges": (
+        ("running", "agree", "detect-or-join"),
+        ("agree", "teardown", "rdzv_agreed"),
+        ("teardown", "establish", "rdzv_torn"),
+        ("establish", "established", "rdzv_established"),
+        ("established", "running", "resume"),
+    ),
+    # flight-recorder instants -> the phase that emits them ("*" = any)
+    "instants": {
+        "rdzv_init": "established",
+        "rdzv_offer_join": "running",
+        "rdzv_claim_loss": "running",
+        "rdzv_agreed": "agree",
+        "rdzv_torn": "teardown",
+        "rdzv_established": "established",
+        "rdzv_timeout": "*",
+        "rdzv_drain_timeout": "teardown",
+        "rdzv_quarantine_rebuild": "establish",
+    },
+    # engine recovery spine: callee tail -> phase index. G018 checks that
+    # recovery paths never call a lower phase after a higher one
+    # (flush -> agree -> drain/retire -> establish -> reshard -> restore).
+    "recovery_order": {
+        "flush_checkpoints": 0,
+        "agree": 1,
+        "drain_collective_chain": 2,
+        "retire_runtime": 2,
+        "establish": 3,
+        "_reshard_world": 4,
+        "_state_from_host": 5,
+    },
+    # tails that mark a function as a recovery path at all (the G018 gate:
+    # ordering is only checked where the rendezvous spine is in play)
+    "recovery_core": ("flush_checkpoints", "retire_runtime", "establish",
+                      "_reshard_world"),
+}
+
+
 def _write_json(path: str, obj: Dict) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:

@@ -1906,15 +1906,19 @@ class Trainer:
                 args={"peer": ident, "reason": reason},
             )
             try:
-                with open(
-                    os.path.join(
-                        hb_dir, f"elastic_detected_{ident}_by_proc{proc_id}.json"
-                    ),
-                    "w",
-                ) as f:
-                    import json
+                import json
 
+                path = os.path.join(
+                    hb_dir, f"elastic_detected_{ident}_by_proc{proc_id}.json"
+                )
+                # G017 protocol-file discipline: sibling watchers read this
+                # marker while we write it, so publish atomically (tmp +
+                # os.replace) — a torn in-place write here is exactly the
+                # half-JSON the rendezvous readers must otherwise survive
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
                     json.dump({"peer": ident, "reason": reason}, f)
+                os.replace(tmp, path)
             except OSError:
                 pass
 
@@ -2207,6 +2211,21 @@ class Trainer:
             else ("flat",)
         ) + (("zero1",) if self.cfg.shard_update else ())
 
+    def _quiesce_pipeline(self) -> None:
+        """Drain the concurrent readers of the topology fields before a
+        mesh/world rebuild (G019 quiesce discipline). The window transfer
+        pipeline's gather/stage threads read ``mesh``/``topology``/
+        ``active_ranks``; "closed by program order" was the sanction for
+        the unlocked writes below, and this turns that program-order
+        argument into an enforced drain: if an abandoned epoch left its
+        pipeline live (exception paths, mid-epoch preemption), close it —
+        ``close`` joins the pool and is idempotent against the context
+        manager's own exit."""
+        pipe = getattr(self, "_live_pipeline", None)
+        if pipe is not None:
+            self._live_pipeline = None
+            pipe.close()
+
     def _reshard_world(self, active: List[int]) -> None:
         """Point the engine at a new active fleet: compact controller
         vectors, survivor topology/mesh, a fresh StepLibrary against it,
@@ -2217,13 +2236,14 @@ class Trainer:
         global fleet and ``proc_id``/``n_proc``/``_proc_roster`` its
         compact shape; each surviving process keeps its own worker slice
         (loss is process-granular across hosts)."""
+        self._quiesce_pipeline()
         cfg = self.cfg
         self.active_ranks = sorted(int(r) for r in active)
         # topology fields below are read by the pipeline's gather/stage
-        # threads (G012 would flag the unlocked cross-thread writes), but a
-        # re-shard only runs after the run loop drained the epoch: the
-        # WindowTransferPipeline is closed and no staging thread is alive
-        # across these statements — synchronized by program order, not locks
+        # threads (G012 would flag the unlocked cross-thread writes); the
+        # _quiesce_pipeline() drain above guarantees no staging thread is
+        # alive across these statements (G019) — previously this relied on
+        # the run loop having drained the epoch, unasserted
         self.world_size = len(self.active_ranks)  # graftlint: disable=G012
         if self.world_size < 1:
             raise RuntimeError("elastic: no surviving workers")
@@ -4557,6 +4577,16 @@ class Trainer:
                     cur_pl, s_switch - cur_off, dec.candidate_batches,
                     bucket=self.cfg.bucket,
                 )
+                # the append is program-order safe only while the launch
+                # frontier still sits at j: gather threads resolve steps
+                # >= s_switch through this table, and only the controller
+                # thread advances the frontier — assert that contract
+                # instead of assuming it (G019 quiesce-discipline family)
+                assert pipe.next_unlaunched() == j, (
+                    "window rebalance raced the transfer pipeline: launch "
+                    f"frontier moved {j} -> {pipe.next_unlaunched()} "
+                    "during the solve"
+                )
                 seg_plans.append((s_switch, rplan))
                 self.shares = np.asarray(dec.candidate_shares, dtype=np.float64)
                 # the MEASURED switch cost covers the whole evaluation-to-
@@ -4676,6 +4706,10 @@ class Trainer:
         with WindowTransferPipeline(
             ranges, gather_window, stage_window, dev_order, meter=meter
         ) as pipe:
+            # published for _quiesce_pipeline (G019): a recovery path
+            # entered while this epoch's pipeline is live must drain it
+            # before mutating the topology fields its threads read
+            self._live_pipeline = pipe
             # kick window 0's gather/puts, then drain the compile barrier
             # while the staging threads work — compile time and transfer
             # time overlap instead of stacking
@@ -4733,6 +4767,11 @@ class Trainer:
                         controller, plan, seg_plans, ranges, pipe, i, epoch,
                         aux_acc, aux_windows, eval_state,
                     )
+        # normal exit: the context manager already drained the pool; drop
+        # the reference so _quiesce_pipeline skips the redundant close. On
+        # exception paths the reference survives deliberately — recovery's
+        # _reshard_world drains through it before touching topology.
+        self._live_pipeline = None
         return first_data
 
     def _replay_window_segment(

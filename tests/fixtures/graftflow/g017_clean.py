@@ -1,0 +1,34 @@
+"""Clean twin of g017_violation.py: the same join-offer write and roster
+read, but disciplined — the write lands on a tmp name and publishes with
+atomic ``os.replace`` (readers see the old file or the new file, never a
+torn one), and the read treats a missing or torn ack as absent. A raw
+``json.dump`` to a NON-protocol path rides along to pin the rule's
+scoping: only functions touching the rendezvous/heartbeat directory are
+held to the discipline.
+"""
+
+import json
+import os
+
+
+def offer_join(rdzv_dir: str, ident: int) -> None:
+    path = os.path.join(rdzv_dir, f"join_p{ident}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"ident": ident}, f)
+    os.replace(tmp, path)
+
+
+def read_roster(rdzv_dir: str):
+    path = os.path.join(rdzv_dir, "ack_g0.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None  # missing or torn: legal at every protocol point
+
+
+def save_report(report_path: str, stats: dict) -> None:
+    # not a protocol file: plain json.dump is fine outside the rdzv dir
+    with open(report_path, "w") as f:
+        json.dump(stats, f)

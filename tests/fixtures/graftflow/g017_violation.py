@@ -1,0 +1,24 @@
+"""Seeded G017 violation (protocol-file discipline): a joiner writes its
+offer file with a bare ``json.dump`` straight onto the rendezvous path —
+a peer whose roster scan races the write reads half a JSON object — and
+reads the roster ack with no try/except, so the torn/missing files that
+are LEGAL at every point of the protocol (a peer can die mid-write; the
+wipe can race a read) crash the reader instead of reading as absent.
+Minimized from the incident the atomic ``_write_json``/tolerant
+``_read_json`` helpers in runtime/rendezvous.py exist to prevent.
+"""
+
+import json
+import os
+
+
+def offer_join(rdzv_dir: str, ident: int) -> None:
+    path = os.path.join(rdzv_dir, f"join_p{ident}.json")
+    with open(path, "w") as f:
+        json.dump({"ident": ident}, f)  # torn in-place protocol write
+
+
+def read_roster(rdzv_dir: str):
+    path = os.path.join(rdzv_dir, "ack_g0.json")
+    with open(path) as f:
+        return json.load(f)  # unguarded: torn/missing ack raises here

@@ -1,0 +1,42 @@
+"""Clean twin of g018_violation.py: the same recovery surface in automaton
+order (flush -> agree -> retire -> establish -> reshard -> restore), plus
+two shapes the rule must tolerate: a phase call wrapped in a retry lambda
+(the engine's ``retry_transient(lambda: self._reshard_world(...))``
+idiom) and an if/else whose arms each run a LOWER phase than the other
+arm's — exclusive branches are separate recovery paths, not inversions.
+"""
+
+
+def retry_transient(fn):
+    return fn()
+
+
+class Recovery:
+    def flush_checkpoints(self):
+        pass
+
+    def agree(self, survivors):
+        return list(survivors)
+
+    def retire_runtime(self):
+        pass
+
+    def establish(self, survivors):
+        pass
+
+    def _reshard_world(self, survivors):
+        pass
+
+    def _state_from_host(self, host_state):
+        return host_state
+
+    def recover(self, survivors, host_state, fast=False):
+        self.flush_checkpoints()
+        roster = self.agree(survivors)
+        if fast:
+            self.establish(roster)
+        else:
+            self.retire_runtime()  # other arm of the same If: no inversion
+            self.establish(roster)
+        retry_transient(lambda: self._reshard_world(roster))
+        return self._state_from_host(host_state)

@@ -1,0 +1,38 @@
+"""Seeded G018 violation (recovery phase order): the recovery path builds
+the NEW world before the old one retired — ``establish`` runs while the
+dead world's wedged collectives still hold the process-global launch
+chain, so the first collective of the survivor mesh serializes behind (or
+poisons itself against) half-dead gloo ops. The automaton extracted from
+runtime/rendezvous.py orders flush -> agree -> drain/retire -> establish
+-> reshard -> restore; this is the establish-before-teardown reorder the
+graftrdzv model checker also catches dynamically (teardown-barrier
+invariant).
+"""
+
+
+class Recovery:
+    def flush_checkpoints(self):
+        pass
+
+    def agree(self, survivors):
+        return list(survivors)
+
+    def retire_runtime(self):
+        pass
+
+    def establish(self, survivors):
+        pass
+
+    def _reshard_world(self, survivors):
+        pass
+
+    def _state_from_host(self, host_state):
+        return host_state
+
+    def recover(self, survivors, host_state):
+        self.flush_checkpoints()
+        roster = self.agree(survivors)
+        self.establish(roster)  # new world up while the old one still runs
+        self.retire_runtime()  # phase 2 after phase 3: the reorder bug
+        self._reshard_world(roster)
+        return self._state_from_host(host_state)

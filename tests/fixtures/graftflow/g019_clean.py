@@ -1,0 +1,43 @@
+"""Clean twin of g019_violation.py: the same rebuild, but the concurrent
+consumer is drained first — ``_drain_staging`` joins the staging thread
+(bounded) before the mesh write, turning the program-order argument into
+an enforced quiesce. G019 accepts a preceding ``*quiesce*``/``*drain*``
+call, a lock held at the write, or a lock held by every caller.
+"""
+
+import threading
+
+
+def build_mesh(devices):
+    return tuple(devices)
+
+
+class Engine:
+    def __init__(self, devices):
+        self._lock = threading.Lock()
+        self._jobs = []
+        self._stopped = False
+        self.mesh = build_mesh(devices)
+        self._stager = threading.Thread(target=self._stage, daemon=True)
+        self._stager.start()
+
+    def _stage(self):
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                if self._jobs:
+                    self._jobs.pop()
+
+    def submit(self, job):
+        with self._lock:
+            self._jobs.append(job)
+
+    def _drain_staging(self):
+        with self._lock:
+            self._stopped = True
+        self._stager.join(timeout=5.0)
+
+    def rebuild(self, devices):
+        self._drain_staging()
+        self.mesh = build_mesh(devices)

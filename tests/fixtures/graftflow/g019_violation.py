@@ -1,0 +1,37 @@
+"""Seeded G019 violation (quiesce discipline): the engine rebuilds its
+device mesh while the staging thread it spawned at construction is still
+live — no lock around the write, no drain/quiesce step before it. The
+"synchronized by program order" argument the in-tree ``_reshard_world``
+used to make is exactly what this shape breaks: a staging thread that
+reads the topology mid-rebuild stages window buffers against a mesh that
+no longer exists. (The in-tree fix is ``_quiesce_pipeline()`` at the top
+of the rebuild.)
+"""
+
+import threading
+
+
+def build_mesh(devices):
+    return tuple(devices)
+
+
+class Engine:
+    def __init__(self, devices):
+        self._lock = threading.Lock()
+        self._jobs = []
+        self.mesh = build_mesh(devices)
+        self._stager = threading.Thread(target=self._stage, daemon=True)
+        self._stager.start()
+
+    def _stage(self):
+        while True:
+            with self._lock:
+                if self._jobs:
+                    self._jobs.pop()
+
+    def submit(self, job):
+        with self._lock:
+            self._jobs.append(job)
+
+    def rebuild(self, devices):
+        self.mesh = build_mesh(devices)  # staging thread still running

@@ -1,0 +1,414 @@
+"""graftrdzv (rendezvous protocol analysis, ISSUE 16): the PROTOCOL table
+declared in runtime/rendezvous.py must load and match the extractor's view
+of the module (writers, instants — no drift), the small-scope model checker
+must prove the live protocol's invariants over 2-3-process worlds with
+crash/wedge faults at every phase boundary AND catch each seeded protocol
+mutation by the expected invariant, the G017-G019 rule families must trip
+on their seeded fixtures while the clean twins (and the shipped tree) stay
+quiet, and `graftscope conformance` must replay spooled rdzv_* instants
+against the automaton with the documented exit statuses."""
+
+import json
+import pathlib
+
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow import (
+    CallGraph,
+    Project,
+    analyze_paths,
+    check_conformance,
+    extract_protocol,
+    load_protocol,
+    run_flow_rules,
+    run_model_check,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.flow.proto import (
+    MUTATIONS,
+    PROTO_DIR_TOKENS,
+    RECOVERY_CORE,
+    RECOVERY_ORDER,
+    classify_protocol_file,
+    rendezvous_source_path,
+)
+from dynamic_load_balance_distributeddnn_tpu.analysis.linter import lint_file
+from dynamic_load_balance_distributeddnn_tpu.obs.scope_cli import (
+    conformance,
+    main as scope_main,
+)
+from dynamic_load_balance_distributeddnn_tpu.obs.spool import SpoolWriter
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "graftflow"
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PKG = REPO / "dynamic_load_balance_distributeddnn_tpu"
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+@pytest.fixture(scope="module")
+def repo_project():
+    """One whole-package Project shared by the interprocedural tests."""
+    return Project.load([str(p) for p in sorted(PKG.rglob("*.py"))])
+
+
+# ------------------------------------------------------------ seeded fixtures
+
+
+@pytest.mark.parametrize(
+    "fixture,expected_code,min_findings",
+    [
+        # torn in-place protocol write + unguarded protocol read
+        ("g017_violation.py", "G017", 2),
+        # retire_runtime (phase 2) sequenced after establish (phase 3)
+        ("g018_violation.py", "G018", 1),
+        # unlocked mesh rebuild with a live staging thread, no quiesce
+        ("g019_violation.py", "G019", 1),
+    ],
+)
+def test_rdzv_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
+    findings = analyze_paths([str(FIXTURES / fixture)])
+    hits = [f for f in findings if f.code == expected_code]
+    assert len(hits) >= min_findings, (fixture, findings)
+    # a seeded fixture must not also trip unrelated flow rules (noise)
+    assert codes(findings) == {expected_code}, findings
+    # nor any single-file rule — each corpus file isolates ONE bug class
+    assert lint_file(str(FIXTURES / fixture)) == []
+
+
+@pytest.mark.parametrize(
+    "fixture",
+    [
+        "g017_clean.py",
+        "g018_clean.py",
+        "g019_clean.py",
+    ],
+)
+def test_rdzv_clean_fixture_is_quiet(fixture):
+    path = str(FIXTURES / fixture)
+    assert analyze_paths([path]) == []
+    assert lint_file(path) == []
+
+
+def test_g017_flags_both_sides_of_the_file_discipline():
+    """ISSUE contract: the raw in-place write AND the unguarded read each
+    get their own finding — write discipline and read tolerance are
+    separate obligations."""
+    findings = analyze_paths([str(FIXTURES / "g017_violation.py")])
+    by_line = {f.line: f.message for f in findings}
+    assert any("os.replace" in m for m in by_line.values()), findings
+    assert any("torn" in m and "read" in m for m in by_line.values()), findings
+    assert {f.symbol.split("::")[-1] for f in findings} == {
+        "offer_join",
+        "read_roster",
+    }
+
+
+def test_g018_names_the_inverted_phases():
+    (finding,) = analyze_paths([str(FIXTURES / "g018_violation.py")])
+    assert "retire_runtime" in finding.message
+    assert "establish" in finding.message
+    assert "phase 2" in finding.message and "phase 3" in finding.message
+
+
+def test_g019_flags_the_pre_fix_reshard_shape():
+    """The in-tree incident shape: `_reshard_world` used to rewrite the
+    topology with only a program-order argument while engine threads ran."""
+    (finding,) = analyze_paths([str(FIXTURES / "g019_violation.py")])
+    assert "self.mesh" in finding.message
+    assert "quiesce" in finding.message or "drain" in finding.message
+
+
+# -------------------------------------------------------- protocol extraction
+
+
+def test_protocol_table_loads_from_rendezvous_source():
+    proto = load_protocol()
+    assert proto["version"] >= 1
+    assert set(proto["files"]) == {
+        "ack", "propose", "torn", "loss", "join", "done",
+    }
+    assert proto["phases"] == (
+        "running", "agree", "teardown", "establish", "established",
+    )
+    # every declared instant maps to a declared phase (or the wildcard)
+    for name, phase in proto["instants"].items():
+        assert name.startswith("rdzv_")
+        assert phase == "*" or phase in proto["phases"], name
+    # the rule-side constants are literal copies of the table's — drift
+    # between the checker and the declaration is itself a bug
+    assert dict(proto["recovery_order"]) == RECOVERY_ORDER
+    assert set(proto["recovery_core"]) == set(RECOVERY_CORE)
+    assert set(proto["dir_tokens"]) <= PROTO_DIR_TOKENS
+
+
+def test_classify_protocol_file_matches_declared_patterns():
+    proto = load_protocol()
+    assert classify_protocol_file("ack_g3.json", proto) == "ack"
+    assert classify_protocol_file("propose_g11_r0_p2.json", proto) == "propose"
+    assert classify_protocol_file("join_p0.json", proto) == "join"
+    assert classify_protocol_file("postmortem.trace.json", proto) is None
+
+
+def test_extractor_agrees_with_the_declared_table(repo_project):
+    """No drift: every declared writer/instant is observed in the module
+    source and vice versa — the mismatch list the G017 rule would report
+    on runtime/rendezvous.py itself is empty."""
+    model = extract_protocol(repo_project)
+    assert model is not None
+    assert model.mismatches == [], model.mismatches
+    # the coordinator ack write and the shared loss-claim write were both
+    # attributed to functions that write protocol files
+    assert any(model.writers.get(kind) for kind in ("ack", "loss")), (
+        model.writers
+    )
+    assert pathlib.Path(rendezvous_source_path()).name == "rendezvous.py"
+
+
+def test_extractor_is_none_on_trees_without_a_rendezvous_module():
+    proj = Project.load([str(FIXTURES / "g017_clean.py")])
+    assert extract_protocol(proj) is None
+
+
+# ----------------------------------------------- small-scope model checking
+
+
+@pytest.mark.parametrize(
+    "scenario,kwargs",
+    [
+        # two steady-state procs, faults at every recovery phase boundary
+        ("steady2", dict(n_procs=2, budget=2)),
+        # three procs: concurrent recoveries, claim races, roster splits
+        ("steady3", dict(n_procs=3, budget=2)),
+        # cold bring-up over a dirty directory (stale gen-9 ack pre-seeded)
+        ("bringup2", dict(n_procs=2, budget=0, stale=True)),
+        # an established pair plus a cold joiner, one fault allowed
+        ("join3", dict(n_procs=3, budget=1, joiner=True)),
+    ],
+)
+def test_model_checker_proves_the_live_protocol(scenario, kwargs):
+    result = run_model_check(**kwargs)
+    assert result["violations"] == [], (scenario, result["violations"])
+    assert result["deadlocks"] == 0, scenario
+    assert result["states"] > 0
+
+
+@pytest.mark.parametrize(
+    "mutation,kwargs,invariant",
+    [
+        # drop the reset_rendezvous_dir wipe: the stale gen-9 ack survives
+        # bring-up and gets adopted as if a live process had published it
+        ("drop_reset_wipe", dict(n_procs=2, budget=0, stale=True),
+         "stale-adoption"),
+        # skip _reset_orbax_barrier_counters: a proc pairs into the new
+        # generation with counters still keyed to the dead one
+        ("skip_orbax_reset", dict(n_procs=2, budget=2), "orbax-reset"),
+        # ignore published loss claims when dispatching collectives: a
+        # ghost roster member wedges the op
+        ("no_claim_adoption", dict(n_procs=3, budget=2), "claim-coherence"),
+        # pair into the new world before every roster member retired the
+        # old client — the establish-before-teardown reorder
+        ("establish_before_teardown", dict(n_procs=3, budget=2),
+         "teardown-barrier"),
+    ],
+)
+def test_model_checker_catches_seeded_mutation(mutation, kwargs, invariant):
+    assert mutation in MUTATIONS
+    result = run_model_check(mutation=mutation, **kwargs)
+    assert result["violations"], (mutation, "mutation survived the checker")
+    assert any(v.startswith(invariant) for v in result["violations"]), (
+        mutation, result["violations"],
+    )
+
+
+def test_mutation_catalogue_is_exercised():
+    """Every seeded mutation the checker knows about has a test above —
+    adding a mutation without a catch assertion must fail loudly."""
+    assert set(MUTATIONS) == {
+        "drop_reset_wipe",
+        "skip_orbax_reset",
+        "no_claim_adoption",
+        "establish_before_teardown",
+    }
+
+
+def test_unknown_mutation_is_rejected():
+    with pytest.raises(ValueError, match="unknown mutation"):
+        run_model_check(2, mutation="nonsense")
+
+
+# ------------------------------------------------------- shipped-tree hygiene
+
+
+def test_shipped_tree_is_clean_under_g017_g019(repo_project):
+    findings = [
+        f
+        for f in run_flow_rules(repo_project, select=["G017", "G018", "G019"])
+    ]
+    assert findings == [], findings
+
+
+def test_thread_inventory_covers_the_recorder_and_rendezvous_threads(
+    repo_project,
+):
+    """ISSUE 16 satellite: the G012 thread inventory must see the spool
+    flusher, the rdzv drain worker, and the heartbeat watcher — the lock
+    discipline of everything they touch is checked interprocedurally."""
+    thread_fns = CallGraph(repo_project).thread_sides()[0]
+    tails = {fn.rsplit("::", 1)[-1] for fn in thread_fns}
+    assert "SpoolWriter._run" in tails, sorted(tails)
+    assert "drain_collective_chain._drain" in tails
+    assert "ProcessHeartbeat.watch._watch" in tails
+
+
+# ------------------------------------------------------- trace conformance
+
+
+def _inst(name, pid, ts, **args):
+    return {"name": name, "ph": "i", "pid": pid, "tid": 1, "ts": ts,
+            "args": args}
+
+
+def _legal_recovery_events(roster=(0, 1), address="h0:9999"):
+    evs = []
+    for pid in roster:
+        evs += [
+            _inst("rdzv_init", pid, 10.0 + pid),
+            _inst("rdzv_agreed", pid, 100.0 + pid, gen=1),
+            _inst("rdzv_torn", pid, 200.0 + pid, gen=1),
+            _inst("rdzv_established", pid, 300.0 + pid, gen=1,
+                  roster=list(roster), address=address),
+        ]
+    return evs
+
+
+def test_conformance_accepts_a_legal_recovery():
+    violations, stats = check_conformance(_legal_recovery_events())
+    assert violations == []
+    assert stats["processes"] == [0, 1]
+    assert stats["generations"] == [1]
+    assert stats["counts"]["rdzv_established"] == 2
+
+
+def test_conformance_tolerates_timeouts_and_unknown_instants():
+    evs = _legal_recovery_events()
+    evs.insert(2, _inst("rdzv_timeout", 0, 50.0, phase="collect"))
+    evs.insert(0, _inst("rdzv_quarantine_rebuild", 1, 5.0))
+    violations, _ = check_conformance(evs)
+    assert violations == []
+
+
+@pytest.mark.parametrize(
+    "events,needle",
+    [
+        # establish skipped the teardown barrier entirely
+        ([_inst("rdzv_established", 0, 1.0, gen=2, roster=[0], address="a")],
+         "without passing the teardown barrier"),
+        # teardown with no prior agreement for that generation
+        ([_inst("rdzv_torn", 0, 1.0, gen=3)], "no prior agreement"),
+        # generations must move strictly forward per process
+        ([_inst("rdzv_agreed", 0, 1.0, gen=1),
+          _inst("rdzv_torn", 0, 2.0, gen=1),
+          _inst("rdzv_established", 0, 3.0, gen=1, roster=[0], address="a"),
+          _inst("rdzv_agreed", 0, 4.0, gen=1)],
+         "already established"),
+        # the same generation established with divergent worlds
+        ([_inst("rdzv_agreed", 0, 1.0, gen=1),
+          _inst("rdzv_torn", 0, 2.0, gen=1),
+          _inst("rdzv_established", 0, 3.0, gen=1, roster=[0, 1],
+                address="a"),
+          _inst("rdzv_agreed", 1, 1.5, gen=1),
+          _inst("rdzv_torn", 1, 2.5, gen=1),
+          _inst("rdzv_established", 1, 3.5, gen=1, roster=[0, 2],
+                address="a")],
+         "divergent worlds"),
+    ],
+)
+def test_conformance_flags_illegal_traces(events, needle):
+    violations, _ = check_conformance(events)
+    assert any(needle in v for v in violations), (needle, violations)
+
+
+# --------------------------------------------------------------- CLI surface
+
+
+def _spool_with_instants(path, pid, instants):
+    sp = SpoolWriter(str(path), pid=pid, ident=pid, base_unix=1000.0,
+                     flush_interval_s=30.0)
+    for name, ts, args in instants:
+        sp.put((name, "rdzv", "i", ts, 0.0, 1, args))
+    sp.close()
+
+
+def _legal_spool_dir(tmp_path):
+    for pid in (0, 1):
+        _spool_with_instants(
+            tmp_path / f"proc{pid}.{pid}.spool", pid,
+            [
+                ("rdzv_init", 10.0 + pid, None),
+                ("rdzv_agreed", 100.0 + pid, {"gen": 1}),
+                ("rdzv_torn", 200.0 + pid, {"gen": 1}),
+                ("rdzv_established", 300.0 + pid,
+                 {"gen": 1, "roster": [0, 1], "address": "h0:9999"}),
+            ],
+        )
+
+
+def test_conformance_cli_passes_a_legal_spool_dir(tmp_path, capsys):
+    _legal_spool_dir(tmp_path)
+    assert scope_main(["conformance", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "conformance: OK" in out
+    assert "rdzv_established" in out
+
+
+def test_conformance_cli_json_reports_stats(tmp_path, capsys):
+    _legal_spool_dir(tmp_path)
+    assert scope_main(["conformance", str(tmp_path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
+    assert payload["violations"] == []
+    assert payload["stats"]["counts"]["rdzv_agreed"] == 2
+
+
+def test_conformance_cli_fails_on_a_violating_trace(tmp_path, capsys):
+    _spool_with_instants(
+        tmp_path / "proc0.0.spool", 0,
+        [("rdzv_established", 50.0,
+          {"gen": 2, "roster": [0], "address": "h0:1"})],
+    )
+    assert scope_main(["conformance", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "VIOLATION" in out and "teardown barrier" in out
+
+
+def test_conformance_cli_reports_instant_free_spools_as_ok(tmp_path):
+    _spool_with_instants(
+        tmp_path / "proc0.0.spool", 0, [],
+    )
+    sp = SpoolWriter(str(tmp_path / "proc1.1.spool"), pid=1, ident=1,
+                     base_unix=1000.0, flush_interval_s=30.0)
+    sp.put(("train", "phase", "X", 0.0, 5.0, 1, {"epoch": 0}))
+    sp.close()
+    text, ok = conformance(str(tmp_path))
+    assert ok
+    assert "no rdzv_* instants" in text
+
+
+def test_conformance_cli_empty_dir_is_a_usage_error(tmp_path, capsys):
+    assert scope_main(["conformance", str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert "no readable spool/trace files" in err
+
+
+def test_decisions_cli_empty_dir_is_a_usage_error(tmp_path, capsys):
+    """Regression (ISSUE 16 satellite): `graftscope decisions` over an
+    empty or missing directory used to print an empty journal and exit 0 —
+    operators piping it into incident tooling read 'no decisions were
+    made' where the truth was 'you pointed me at nothing'."""
+    assert scope_main(["decisions", str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert "no readable spool/trace files" in err
+    missing = tmp_path / "never_created"
+    assert scope_main(["decisions", str(missing)]) == 2

@@ -450,6 +450,16 @@ def test_mh_sigkill_spool_postmortem(tmp_path):
     span_names = {s["name"] for s in surv.get("recovery_spans", ())}
     assert {"rdzv_agree", "rdzv_establish"} <= span_names
     assert any(ev["name"] == "rdzv_agreed" for ev in report["timeline"])
+    # ISSUE 16 acceptance: the same chaos spools replay clean against the
+    # extracted protocol automaton — a real SIGKILL recovery is a LEGAL
+    # trace, torn victim tail and all
+    from dynamic_load_balance_distributeddnn_tpu.obs.scope_cli import (
+        conformance,
+    )
+
+    text, ok = conformance(str(spool_dir))
+    assert ok, f"chaos spools violate the rendezvous protocol:\n{text}"
+    assert "rdzv_agreed" in text
 
 
 def test_elastic_peer_loss_detection(tmp_path):

@@ -93,6 +93,96 @@ def test_spool_torn_tail_is_tolerated(tmp_path):
     assert ch["truncated"] and len(ch["events"]) == 1
 
 
+def _frame_boundaries(data: bytes):
+    """Byte offsets at which a cut leaves only WHOLE frames behind."""
+    bounds = {0}
+    pos = 0
+    while pos < len(data):
+        sp = data.find(b" ", pos, pos + 20)
+        body_len = int(data[pos:sp])
+        pos = sp + 1 + body_len + 1
+        bounds.add(pos)
+    return bounds
+
+
+def test_read_spool_tolerates_truncation_at_every_offset(tmp_path):
+    """Property fuzz (ISSUE 16 satellite): for ANY prefix of a healthy
+    multi-segment spool — a SIGKILL can land between any two bytes of a
+    write — read_spool never raises, returns a frame-granular prefix of
+    the full event stream in the right segments, and reports truncated
+    exactly when the cut falls inside a frame. Drops are never invented."""
+    import random
+
+    path = tmp_path / "p.spool"
+    sp = _mk_spool(path, ident=1, base_unix=100.0, flush_interval_s=30.0)
+    for i in range(4):
+        sp.put((f"a{i}", "phase", "X", float(i), 1.0, 1, {"k": i}))
+    sp.flush()
+    sp.note_rebase(200.0)
+    for i in range(4):
+        sp.put((f"b{i}", "phase", "X", float(i), 1.0, 1, None))
+    sp.close()
+    data = path.read_bytes()
+    full = read_spool(str(path))
+    assert not full["truncated"]
+    full_names = [e[0] for _, seg in full["segments"] for e in seg]
+    assert full_names == [f"a{i}" for i in range(4)] + [
+        f"b{i}" for i in range(4)
+    ]
+    bounds = _frame_boundaries(data)
+    rng = random.Random(0xC0FFEE)
+    offsets = set(rng.sample(range(len(data) + 1), 200)) | bounds
+    for cut in sorted(offsets):
+        path.write_bytes(data[:cut])
+        got = read_spool(str(path))  # the property: never an exception
+        names = [e[0] for _, seg in got["segments"] for e in seg]
+        assert names == full_names[: len(names)], cut
+        assert got["truncated"] == (cut not in bounds), cut
+        assert got["dropped"] == 0, cut
+        # rebased events never leak into the pre-rebase timebase
+        for base, seg in got["segments"]:
+            if any(n.startswith("b") for n, *_ in seg):
+                assert base == 200.0, cut
+    path.write_bytes(data)
+
+
+def test_read_spool_fuzz_random_spools_random_tears(tmp_path):
+    """Randomized end-to-end: random segment/rebase layouts, random cut
+    offsets, random garbage tails — every trial parses to a prefix."""
+    import random
+
+    rng = random.Random(20260806)
+    for trial in range(25):
+        path = tmp_path / f"t{trial}.spool"
+        sp = _mk_spool(
+            path, ident=trial, base_unix=50.0, flush_interval_s=30.0
+        )
+        expect = []
+        for seg in range(rng.randint(1, 4)):
+            if seg:
+                sp.note_rebase(50.0 + 100.0 * seg)
+            for i in range(rng.randint(0, 5)):
+                name = f"s{seg}e{i}"
+                args = {"n": i} if rng.random() < 0.5 else None
+                sp.put((name, "phase", "X", float(i), 1.0, 1, args))
+                expect.append(name)
+            sp.flush()
+        sp.close()
+        data = path.read_bytes()
+        if rng.random() < 0.3:
+            mangled = data + b"87 {torn-mid-write"  # header > body
+        else:
+            mangled = data[: rng.randint(0, len(data))]
+        path.write_bytes(mangled)
+        got = read_spool(str(path))
+        names = [e[0] for _, seg in got["segments"] for e in seg]
+        assert names == expect[: len(names)], (trial, names)
+        assert got["dropped"] == 0
+        if len(mangled) > len(data):
+            # garbage tail: everything real survives, verdict is torn
+            assert names == expect and got["truncated"]
+
+
 def test_spool_bounded_queue_drops_oldest_and_counts(tmp_path):
     path = tmp_path / "p.spool"
     sp = SpoolWriter(

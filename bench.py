@@ -1283,6 +1283,161 @@ def _tc(*args) -> bool:
         return False
 
 
+def _resnet18_grad_sizes() -> list:
+    """resnet18-scale gradient tree: ~11.0M f32 elements (44 MB) over 19
+    conv/dense/bn-shaped leaves — the bytes profile of the repo's standard
+    bench model, without paying its CPU model-compile wall inside a comm
+    microbench. Shared by the 2-host and 3-tier grad_comm workers."""
+    return (
+        [64 * 3 * 7 * 7]
+        + [64 * 64 * 3 * 3] * 4
+        + [64 * 128 * 3 * 3, 128 * 128 * 3 * 3, 128 * 128 * 3 * 3,
+           128 * 128 * 3 * 3]
+        + [128 * 256 * 3 * 3, 256 * 256 * 3 * 3, 256 * 256 * 3 * 3,
+           256 * 256 * 3 * 3]
+        + [256 * 512 * 3 * 3, 512 * 512 * 3 * 3, 512 * 512 * 3 * 3,
+           512 * 512 * 3 * 3]
+        + [512 * 10, 512, 512]
+    )
+
+
+def _run_grad_comm_tier3_worker(proc_id: int, num_procs: int, port: int) -> int:
+    """One process of the 3-tier grad_comm leg (ISSUE 17): two gloo
+    processes x 4 in-process CPU devices = a ``(dcn 2, host 2, device 2)``
+    fabric where ONLY the dcn hop rides the (shaped) loopback — the host and
+    device levels are in-process memory, the fast-link classes of a real
+    pod. Times three arms on the same resnet18-scale tree:
+
+    * flat — per-leaf f32 psum over all three axes;
+    * hier2 — the PR-12 hardwired two-level spine (``hier_tree_allreduce``,
+      hosts=2 x 4 devices, its default int8 wire): ONE compressed hop, but
+      the codec is fixed regardless of how slow the link actually is;
+    * tree3 — ``tree_allreduce`` over the 3-level tree with the per-hop
+      codec the ISSUE-17 cost model (``choose_wires``) picks from the
+      actual link classes: the shaped dcn rate vs memory-class in-process
+      rates. On a DCN-bound fabric it compresses the slow hop harder
+      (int4) and keeps the fast hops exact — fewer bytes on the ONLY link
+      that matters, so the wall undercuts both fixed arms.
+
+    Honesty note for this tier: under the gloo CPU backend even the
+    in-process hops ride loopback sockets, so the shaped rate throttles
+    every level, not just dcn — the fp32 phases dominate all three arms
+    and compress the margins. The structural claim (per-hop codec wall <=
+    flat and <= fixed-int8 2-level) still measures cleanly; a real pod's
+    in-host links would only widen it. The tree is a ~3.9M-element slice
+    of the resnet18 profile (the three largest conv leaves dropped) so
+    both shaped rates finish inside the worker timeout."""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamic_load_balance_distributeddnn_tpu.parallel import wire as wirefmt
+    from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+        hier_mesh,
+        shard_map,
+        tree_mesh,
+    )
+
+    devs = jax.devices()
+    assert num_procs == 2 and len(devs) == 8, (num_procs, len(devs))
+    names3, sizes3 = ("dcn", "host", "device"), (2, 2, 2)
+    mesh3 = tree_mesh(devs, names3, sizes3)
+    mesh2 = hier_mesh(devs, 2)  # the PR-12 factorization of the same fleet
+
+    sizes = [s for s in _resnet18_grad_sizes() if s != 512 * 512 * 3 * 3]
+    n_elems = int(sum(sizes))
+    rng = np.random.RandomState(7 + proc_id)
+    local = [rng.standard_normal((4, s)).astype(np.float32) for s in sizes]
+
+    # per-hop codec from the shipped cost model at the ACTUAL link classes:
+    # the shaped loopback rate on the dcn hop, memory-class rates on the
+    # in-process hops — compression lands on the slow link only
+    dcn_rate = float(os.environ.get("BENCH_GRAD_COMM_RATE_MBIT", 200)) * 1e6 / 8
+    mem_rate = 1e10
+    wires3 = wirefmt.choose_wires(sizes3, [dcn_rate, mem_rate, mem_rate])
+
+    reps = int(os.environ.get("BENCH_GRAD_COMM_TIER3_REPS", 2))
+
+    def timed(mesh, body):
+        bx = tuple(mesh.axis_names)
+        sh = NamedSharding(mesh, P(bx))
+        stacked = [
+            jax.make_array_from_process_local_data(sh, a) for a in local
+        ]
+        fn = jax.jit(
+            shard_map(
+                body, mesh=mesh,
+                in_specs=tuple(P(bx) for _ in stacked),
+                out_specs=tuple(P() for _ in stacked),
+                check_vma=False,
+            )
+        )
+        jax.block_until_ready(fn(*stacked))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*stacked))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    ax3 = tuple(mesh3.axis_names)
+    h_ax2, d_ax2 = mesh2.axis_names
+
+    def flat_body(*st):
+        return tuple(jax.lax.psum(jnp.sum(g, axis=0), ax3) for g in st)
+
+    def hier2_body(*st):
+        out, _res = wirefmt.hier_tree_allreduce(
+            [jnp.sum(g, axis=0) for g in st],
+            jax.random.PRNGKey(3), h_ax2, d_ax2, 2, 4, "int8",
+        )
+        return tuple(out)
+
+    def tree3_body(*st):
+        out, _res = wirefmt.tree_allreduce(
+            [jnp.sum(g, axis=0) for g in st],
+            jax.random.PRNGKey(3), names3, sizes3, wires3,
+        )
+        return tuple(out)
+
+    res = {
+        "flat_wall_s": round(timed(mesh3, flat_body), 4),
+        "hier2_int8_wall_s": round(timed(mesh2, hier2_body), 4),
+        "tree3_wall_s": round(timed(mesh3, tree3_body), 4),
+        "tree3_wires": list(wires3),
+        "tree_elems": n_elems,
+    }
+    # per-hop bytes-on-wire, per device per combine — the engine's
+    # _modeled_comm_step_s accounting (innermost fp32 RS+AG, middle
+    # compressed-up + fp32 gather-down, top compressed all-reduce), so the
+    # bench's detail matches what the controller's comm term is fed
+    w3 = wirefmt.tree_hop_widths(n_elems, sizes3)
+    w2 = wirefmt.tree_hop_widths(n_elems, (2, 4))
+    res["tree3_hop_bytes"] = {
+        "dcn": w3[0] * wirefmt.wire_payload_bytes(wires3[0], sizes3[0]),
+        "host": w3[1] * (wirefmt.wire_payload_bytes(wires3[1], sizes3[1]) + 4),
+        "device": 2 * n_elems * 4,
+    }
+    res["hier2_hop_bytes"] = {
+        "dcn": w2[0] * wirefmt.wire_payload_bytes("int8", 2),
+        "device": 2 * n_elems * 4,
+    }
+    res["flat_hop_bytes"] = {"all_links": 2 * n_elems * 4}
+    if proc_id == 0:
+        print("RESULT " + json.dumps(res), flush=True)
+    return 0
+
+
 def run_grad_comm_worker(proc_id: int, num_procs: int, port: int) -> int:
     """One host of the grad_comm A/B fabric: a single-device process on the
     gloo CPU collectives backend — every cross-process byte rides the
@@ -1300,6 +1455,8 @@ def run_grad_comm_worker(proc_id: int, num_procs: int, port: int) -> int:
     compressed hop; on multi-chip hosts the reduce-scatter additionally
     divides the hop payload by D (bytes recorded per arm by the engine's
     comm_bytes series)."""
+    if os.environ.get("BENCH_GRAD_COMM_TIER3") == "1":
+        return _run_grad_comm_tier3_worker(proc_id, num_procs, port)
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     import jax
 
@@ -1331,21 +1488,7 @@ def run_grad_comm_worker(proc_id: int, num_procs: int, port: int) -> int:
     n_d = int(mesh.shape[d_ax])
     bx = (h_ax, d_ax)
 
-    # resnet18-scale gradient tree: ~11.0M f32 elements (44 MB) over 19
-    # conv/dense/bn-shaped leaves — the bytes profile of the repo's
-    # standard bench model, without paying its CPU model-compile wall
-    # inside a comm microbench
-    sizes = (
-        [64 * 3 * 7 * 7]
-        + [64 * 64 * 3 * 3] * 4
-        + [64 * 128 * 3 * 3, 128 * 128 * 3 * 3, 128 * 128 * 3 * 3,
-           128 * 128 * 3 * 3]
-        + [128 * 256 * 3 * 3, 256 * 256 * 3 * 3, 256 * 256 * 3 * 3,
-           256 * 256 * 3 * 3]
-        + [256 * 512 * 3 * 3, 512 * 512 * 3 * 3, 512 * 512 * 3 * 3,
-           512 * 512 * 3 * 3]
-        + [512 * 10, 512, 512]
-    )
+    sizes = _resnet18_grad_sizes()
     rng = np.random.RandomState(7)
     sh = NamedSharding(mesh, P(bx))
     stacked = [
@@ -1513,6 +1656,53 @@ def _elastic_mh_recovery_ab() -> dict:
         _sh.rmtree(tmp, ignore_errors=True)
 
 
+def _grad_comm_world(num_procs: int, env_extra: dict, timeout_s: float):
+    """Spawn a ``num_procs``-process gloo grad_comm worker world on a fresh
+    port and parse rank 0's ``RESULT`` line. Returns ``(result_dict, None)``
+    or ``(None, error_string)``; hung workers are killed so a dead world
+    never pins the port or contends with later timed arms."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--grad-comm-worker", str(i), str(num_procs), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in range(num_procs)
+    ]
+    try:
+        outs = [p.communicate(timeout=timeout_s) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    line = next(
+        (
+            ln
+            for o, _e in outs
+            for ln in o.splitlines()
+            if ln.startswith("RESULT ")
+        ),
+        None,
+    )
+    if line is None or any(p.returncode != 0 for p in procs):
+        sys.stderr.write(outs[0][1][-800:] + "\n")
+        return None, (
+            f"worker rcs {[p.returncode for p in procs]}; no RESULT line"
+        )
+    return json.loads(line[len("RESULT "):]), None
+
+
 def run_grad_comm_ab(out_path: str) -> int:
     """Hierarchical-vs-flat gradient-collective A/B (ISSUE 12 acceptance
     field ``grad_comm_ab``), in a dedicated subprocess tree.
@@ -1531,7 +1721,15 @@ def run_grad_comm_ab(out_path: str) -> int:
     run cannot leave the fabric throttled — the run_arms caller also
     best-effort-unshapes after this subprocess exits, covering a SIGKILL
     that skips the finally). No tc available -> the leg is skipped with an
-    explicit marker (parity still reported)."""
+    explicit marker (parity still reported).
+
+    Leg 3 (ISSUE 17, the 3-tier wall): a (dcn, host, device) = (2, 2, 2)
+    fabric — two gloo processes x 4 in-process devices, only the dcn hop on
+    the shaped loopback — timed at TWO DCN rates
+    (BENCH_GRAD_COMM_TIER3_RATES, default 200,60 mbit), proving the
+    per-hop codec chosen by the cost model puts the N-level wall at or
+    under both the flat and the fixed-int8 two-level arms, with per-hop
+    bytes-on-wire recorded per arm."""
     done = _install_init_watchdog()
     os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     import jax
@@ -1605,55 +1803,13 @@ def run_grad_comm_ab(out_path: str) -> int:
         _write_atomic(out_path, ab)
         return 0
     try:
-        import socket
-
-        s = socket.socket()
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
-        s.close()
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        procs = [
-            subprocess.Popen(
-                [sys.executable, os.path.abspath(__file__),
-                 "--grad-comm-worker", str(i), "2", str(port)],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                env=env,
-            )
-            for i in range(2)
-        ]
-        try:
-            outs = [
-                p.communicate(
-                    timeout=float(
-                        os.environ.get("BENCH_GRAD_COMM_TIMEOUT", 600)
-                    )
-                )
-                for p in procs
-            ]
-        finally:
-            # a hung gloo rendezvous/collective must not leave two workers
-            # contending with every later timed arm (and pinning the port)
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-                    p.wait()
-        line = next(
-            (
-                ln
-                for o, _e in outs
-                for ln in o.splitlines()
-                if ln.startswith("RESULT ")
-            ),
-            None,
+        res, err = _grad_comm_world(
+            2, {}, float(os.environ.get("BENCH_GRAD_COMM_TIMEOUT", 600))
         )
-        if line is None or any(p.returncode != 0 for p in procs):
-            ab["error"] = (
-                f"worker rcs {[p.returncode for p in procs]}; no RESULT line"
-            )
-            sys.stderr.write(outs[0][1][-800:] + "\n")
+        if err is not None:
+            ab["error"] = err
         else:
-            ab.update(json.loads(line[len("RESULT "):]))
+            ab.update(res)
             # bytes each arm puts on the shaped DCN per combine (2 hosts,
             # 1 device/host: the full tree crosses; the hier hop rides the
             # wire's sum dtype) — the engine records the same accounting
@@ -1682,6 +1838,59 @@ def run_grad_comm_ab(out_path: str) -> int:
     finally:
         if not _tc("qdisc", "del", "dev", "lo", "root"):
             sys.stderr.write("[bench] WARNING: failed to unshape lo\n")
+    _write_atomic(out_path, ab)
+
+    # ---- leg 3: 3-tier fabric at TWO shaped DCN rates (ISSUE 17) ----
+    # Two gloo processes x 4 in-process devices = (dcn 2, host 2, device 2);
+    # only the dcn hop rides the shaped loopback. Run the three arms at two
+    # DCN classes (PR 12's bandwidth-bound point and a tighter link) — at
+    # both, the cost model's per-hop codec must put the N-level wall at or
+    # under the flat AND fixed-int8 two-level arms. Each rate is shaped
+    # fresh and unshaped in a finally, same discipline as leg 2.
+    rates3 = [
+        int(r)
+        for r in os.environ.get(
+            "BENCH_GRAD_COMM_TIER3_RATES", "200,60"
+        ).split(",")
+        if r.strip()
+    ]
+    tier3 = {}
+    for r3 in rates3:
+        key = f"{r3}mbit"
+        _tc("qdisc", "del", "dev", "lo", "root")
+        if not _tc(
+            "qdisc", "add", "dev", "lo", "root", "tbf",
+            "rate", f"{r3}mbit", "burst", "1mb", "latency", "800ms",
+        ):
+            tier3[key] = {"error": "tc/tbf unavailable"}
+            continue
+        try:
+            res, err = _grad_comm_world(
+                2,
+                {
+                    "BENCH_GRAD_COMM_TIER3": "1",
+                    "BENCH_GRAD_COMM_RATE_MBIT": str(r3),
+                },
+                float(os.environ.get("BENCH_GRAD_COMM_TIMEOUT", 600)),
+            )
+            if err is not None:
+                tier3[key] = {"error": err}
+            else:
+                if res.get("tree3_wall_s"):
+                    res["speedup_vs_flat_x"] = round(
+                        res["flat_wall_s"] / res["tree3_wall_s"], 3
+                    )
+                    res["speedup_vs_hier2_x"] = round(
+                        res["hier2_int8_wall_s"] / res["tree3_wall_s"], 3
+                    )
+                tier3[key] = res
+        except Exception as e:  # noqa: BLE001 — never leave lo shaped
+            tier3[key] = {"error": repr(e)}
+        finally:
+            if not _tc("qdisc", "del", "dev", "lo", "root"):
+                sys.stderr.write("[bench] WARNING: failed to unshape lo\n")
+        _write_atomic(out_path, {**ab, "tier3": tier3})
+    ab["tier3"] = tier3
     _write_atomic(out_path, ab)
     return 0
 

@@ -280,6 +280,28 @@ def _literal_value(node: ast.expr):
     return None
 
 
+def _sym_axis_tuple(node: ast.expr):
+    """Mixed axis-tuple spelling at a call site — ``(HOST, "rak",
+    self._ax)``: literal string members stay as-is, name/attribute members
+    become ``"$<dotted>"`` resolution tokens (the convention the mesh
+    rules' axis resolver already walks for scalar axis args). None unless
+    the expression is a tuple whose EVERY member is one of those two
+    shapes — a call- or subscript-valued member keeps the whole tuple
+    opaque (errs quiet), same contract as the local-bind resolver."""
+    if not isinstance(node, ast.Tuple) or not node.elts:
+        return None
+    out = []
+    for el in node.elts:
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            out.append(el.value)
+            continue
+        tok = dotted_name(el)
+        if not tok:
+            return None
+        out.append("$" + tok)
+    return tuple(out)
+
+
 @dataclass(frozen=True)
 class CallFact:
     """One call site, shallow within its statement."""
@@ -302,6 +324,9 @@ class CallFact:
     spec_kwargs: Tuple[Tuple[str, Optional["SpecCtor"]], ...] = ()
     lit_args: Tuple[object, ...] = ()
     lit_kwargs: Tuple[Tuple[str, object], ...] = ()
+    # per positional arg: mixed axis-tuple spelling ((HOST, "rak") ->
+    # ("$HOST", "rak")), None where the arg is not such a tuple
+    sym_tuple_args: Tuple[object, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -694,6 +719,7 @@ class _FunctionLowerer:
                 (kw.arg or "**", _literal_value(kw.value))
                 for kw in node.keywords
             ),
+            sym_tuple_args=tuple(_sym_axis_tuple(a) for a in node.args),
         )
 
     def _tree_map_synthetics(

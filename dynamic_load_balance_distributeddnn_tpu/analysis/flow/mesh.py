@@ -658,6 +658,16 @@ class MeshModel:
             entries = [lit]
         elif isinstance(lit, tuple) and all(isinstance(a, str) for a in lit):
             entries = list(lit)
+        elif (
+            idx < len(call.sym_tuple_args)
+            and call.sym_tuple_args[idx] is not None
+        ):
+            # mixed call-site tuple — (DCN, "rak", HOST, self._ax): string
+            # members are concrete, "$tok" members ride the same
+            # constant/param/local/attribute resolution as scalar axis
+            # args below (ISSUE 17: N-tuples of ANY length resolve
+            # member-by-member, they no longer err quiet)
+            entries = list(call.sym_tuple_args[idx])
         elif idx < len(call.args) and call.args[idx]:
             entries = [f"${call.args[idx]}"]
         else:

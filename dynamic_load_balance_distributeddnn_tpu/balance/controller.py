@@ -86,15 +86,27 @@ class SwitchDecision:
 
 
 def step_time(
-    rates: np.ndarray, batches: np.ndarray, groups: Sequence[Sequence[int]]
+    rates: np.ndarray,
+    batches: np.ndarray,
+    groups: Sequence[Sequence[int]],
+    comm_s: float = 0.0,
 ) -> float:
     """Modeled per-step wall under a batch split: workers sharing a device
     serialize (sum), devices run in parallel (max) — the elastic dispatch
-    topology's cost model."""
+    topology's cost model. ``comm_s`` is the gradient-collective wall the
+    step pays AFTER the slowest device finishes its compute (ISSUE 17):
+    batch-split-independent (the wire moves the same bytes whatever the
+    shares), so it is additive — it shifts both modeled walls equally and
+    therefore damps the RELATIVE win (hysteresis sees win/cur_step), keeping
+    the controller honest on comm-bound topologies where a compute
+    rebalance buys less of the step than the compute-only model claims."""
     r = np.asarray(rates, dtype=np.float64)
     b = np.asarray(batches, dtype=np.float64)
     per_worker = r * b
-    return float(max(sum(per_worker[w] for w in g) for g in groups if len(g)))
+    compute = float(
+        max(sum(per_worker[w] for w in g) for g in groups if len(g))
+    )
+    return compute + max(float(comm_s), 0.0)
 
 
 class OnlineRebalanceController:
@@ -130,6 +142,11 @@ class OnlineRebalanceController:
         self.rate_alpha = float(rate_alpha)
         self.cost_init = float(cost_init)
         self.logger = logger
+        # modeled per-step gradient-collective wall (seconds): the engine
+        # sets it from _comm_bytes_per_step over the probe's measured link
+        # rates when --grad_comm hier resolves (ISSUE 17); 0.0 = compute-only
+        # model (flat combine or no probe data)
+        self.comm_step_s = 0.0
         # EMA state
         self.rates: Optional[np.ndarray] = None  # seconds/example per worker
         self.wall_scale = 1.0  # bounded measured/modeled wall feedback
@@ -271,8 +288,14 @@ class OnlineRebalanceController:
             return self._record_decision(
                 SwitchDecision(False, "same-plan", batches, new_shares), c, b_cur
             )
-        cur_step = step_time(c, b_cur, self.groups) * self.wall_scale
-        new_step = step_time(c, batches, self.groups) * self.wall_scale
+        cur_step = (
+            step_time(c, b_cur, self.groups, comm_s=self.comm_step_s)
+            * self.wall_scale
+        )
+        new_step = (
+            step_time(c, batches, self.groups, comm_s=self.comm_step_s)
+            * self.wall_scale
+        )
         win = (cur_step - new_step) * remaining_steps
         cost = self.cost_estimate()
         dec = SwitchDecision(
@@ -378,6 +401,7 @@ class OnlineRebalanceController:
                 else None
             ),
             "wall_scale": round(self.wall_scale, 4),
+            "comm_step_s": round(self.comm_step_s, 6),
             "decisions": len(self.journal),
             "last_decision": dict(self.journal[-1]) if self.journal else None,
         }

@@ -226,6 +226,36 @@ class Config:
                                        # the n devices into this many "host"
                                        # groups. 0 = derive from the real
                                        # process topology.
+    hier_levels: str = ""              # N-level topology declaration
+                                       # (ISSUE 17): comma list of
+                                       # name:size OUTER levels,
+                                       # outermost (slowest link) first —
+                                       # e.g. "pod:2,host:2"; the innermost
+                                       # device level is implicit and
+                                       # absorbs the remainder. Prefix
+                                       # "learned" (bare, or
+                                       # "learned,host:2,...") merges
+                                       # adjacent levels the bandwidth
+                                       # probe measures as the same link
+                                       # class. "" = the two-level
+                                       # host/device split (hier_hosts /
+                                       # process topology).
+    grad_comm_wires: str = ""          # per-hop wire codecs for the tree
+                                       # combine, outermost hop first,
+                                       # comma list (innermost must be
+                                       # fp32), e.g. "int4,int8,fp32";
+                                       # "auto" = choose per hop from the
+                                       # bandwidth probe's measured link
+                                       # rates (parallel/wire.py
+                                       # choose_wires). "" = legacy:
+                                       # grad_comm_wire on the outermost
+                                       # hop, fp32 below.
+    dcn_probe_gate: float = 0.95       # hier-vs-flat probe verdict ratio:
+                                       # hier wins when its measured wall
+                                       # < gate * flat wall (the margin a
+                                       # structural change must clear
+                                       # before it is worth a recompile
+                                       # universe).
     compress_grads: str = ""           # "int8": gradient collective quantized
                                        # to 127 levels (shared pmax scale,
                                        # stochastic rounding — unbiased, no
@@ -547,6 +577,25 @@ class Config:
             raise ValueError("grad_comm_wire must be 'fp32', 'int8' or 'int4'")
         if self.hier_hosts < 0:
             raise ValueError("hier_hosts must be >= 0 (0 = real topology)")
+        if self.hier_levels:
+            from dynamic_load_balance_distributeddnn_tpu.parallel.topology import (
+                parse_hier_levels,
+            )
+
+            spec = self.hier_levels.strip()
+            if spec == "learned" or spec.startswith("learned,"):
+                spec = spec[len("learned"):].lstrip(",")
+            parse_hier_levels(spec)  # raises on malformed entries
+        if self.grad_comm_wires and self.grad_comm_wires != "auto":
+            for w in self.grad_comm_wires.split(","):
+                if w.strip() not in ("fp32", "int8", "int4"):
+                    raise ValueError(
+                        f"grad_comm_wires entry {w.strip()!r} must be "
+                        "'fp32', 'int8' or 'int4' (or the whole flag "
+                        "'auto')"
+                    )
+        if not (0.0 < self.dcn_probe_gate <= 1.5):
+            raise ValueError("dcn_probe_gate must be in (0, 1.5]")
         if self.grad_comm == "hier" and self.compress_grads:
             raise ValueError(
                 "grad_comm=hier subsumes compress_grads: the cross-host hop "
@@ -730,6 +779,20 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--hier_hosts", type=int, default=d.hier_hosts,
                    help="Synthetic host-axis size for single-process meshes "
                         "(CPU tiers/tests); 0 = real process topology.")
+    p.add_argument("--hier_levels", type=str, default=d.hier_levels,
+                   help="N-level topology declaration for the tree combine: "
+                        "comma list of name:size outer levels, outermost "
+                        "first (e.g. 'pod:2,host:2'); prefix 'learned' to "
+                        "merge probe-indistinguishable levels; '' = the "
+                        "two-level host/device split.")
+    p.add_argument("--grad_comm_wires", type=str, default=d.grad_comm_wires,
+                   help="Per-hop wire codecs, outermost first (e.g. "
+                        "'int4,int8,fp32'; innermost must be fp32); 'auto' "
+                        "= choose per hop from measured link rates; '' = "
+                        "grad_comm_wire on the outermost hop only.")
+    p.add_argument("--dcn_probe_gate", type=float, default=d.dcn_probe_gate,
+                   help="Bandwidth-probe verdict ratio: hier wins when its "
+                        "wall < gate * flat wall.")
     p.add_argument("--compress_grads", type=str, default=d.compress_grads,
                    choices=["", "int8"],
                    help="Quantized gradient collective (stochastic rounding, "

@@ -149,6 +149,23 @@ class MetricsRegistry:
         }
         if comm:
             comm["grad_comm"] = self.recorder.meta.get("grad_comm", "flat")
+            for k in ("grad_comm_levels", "grad_comm_wires"):
+                if k in self.recorder.meta:
+                    comm[k] = self.recorder.meta[k]
+            # bandwidth-probe verdict (ISSUE 17): the measured hier/flat
+            # wall ratio, the gate it was judged against, and the per-level
+            # link rates the codec choice was made from — queryable live,
+            # not only a log line
+            bw = self.recorder.meta.get("link_bandwidth")
+            if isinstance(bw, dict):
+                comm["probe"] = {
+                    k: bw[k]
+                    for k in (
+                        "wall_ratio", "gate_ratio", "hier_wins",
+                        "level_bytes_per_s", "levels",
+                    )
+                    if k in bw
+                }
             out["comm"] = comm
         # per-device peak-memory series (ISSUE 13): backend allocator stats
         # where available, host-RSS fallback on CPU — what the zero1 A/B

@@ -91,6 +91,26 @@ def data_mesh(devices: Optional[Sequence] = None, axis: str = DATA_AXIS) -> Mesh
     return Mesh(np.array(devices), (axis,))
 
 
+def tree_mesh(devices: Sequence, names: Sequence[str], sizes: Sequence[int]) -> Mesh:
+    """N-level mesh over a flat device list (ISSUE 17): reshape ROW-MAJOR to
+    the topology tree's level sizes, outermost-first — so the flat device
+    numbering (mixed-radix over the axis coordinates) matches the flat
+    :func:`data_mesh` order and per-device work (rng folds, batch slices) is
+    identical under ANY factorization. The device list must already be
+    grouped in mesh order (contiguous blocks per outer level —
+    ``parallel/topology.py`` derives exactly such trees)."""
+    devices = list(devices)
+    names, sizes = tuple(names), tuple(int(s) for s in sizes)
+    n = 1
+    for s in sizes:
+        n *= s
+    if len(names) != len(sizes) or n != len(devices):
+        raise ValueError(
+            f"{len(devices)} devices do not factor into levels {list(zip(names, sizes))}"
+        )
+    return Mesh(np.array(devices).reshape(sizes), names)
+
+
 def hier_mesh(
     devices: Sequence,
     hosts: int,
@@ -99,17 +119,16 @@ def hier_mesh(
 ) -> Mesh:
     """Two-level ``(host, device)`` mesh over a flat device list: row k holds
     host k's chips (the list must already be host-grouped in mesh order —
-    parallel/topology.py ``factor_hosts`` validates exactly that). Device
-    order is row-major, so position ``h*D + d`` matches the flat
-    :func:`data_mesh` order and per-device work (rng folds, batch slices) is
-    identical under either factorization."""
+    parallel/topology.py ``factor_hosts`` validates exactly that). A thin
+    delegate onto the N-level :func:`tree_mesh`."""
     devices = list(devices)
     if hosts < 1 or len(devices) % hosts:
         raise ValueError(
             f"{len(devices)} devices do not factor into {hosts} hosts"
         )
-    arr = np.array(devices).reshape(hosts, len(devices) // hosts)
-    return Mesh(arr, (host_axis, device_axis))
+    return tree_mesh(
+        devices, (host_axis, device_axis), (hosts, len(devices) // hosts)
+    )
 
 
 def mesh_batch_axes(mesh: Mesh) -> Union[str, tuple]:
@@ -123,30 +142,42 @@ def mesh_batch_axes(mesh: Mesh) -> Union[str, tuple]:
 
 def zero1_chunk_axes(mesh: Mesh) -> Union[str, tuple]:
     """The PartitionSpec entry for a ZeRO-1 1/n optimizer chunk's flat
-    vector: the data axis on a flat mesh; on a two-level mesh the
-    ``(device, host)`` tuple — DEVICE-major, the reverse of the batch
-    entry. The hierarchical sharded update produces exactly this block
-    order: the in-host reduce-scatter gives device d the d-th 1/D slice,
-    and the cross-host hop's re-split hands host h the h-th sub-slice of
-    it, so device (h, d) owns flat block ``d*H + h`` — which is what a dim
-    split ``(device, host)``-major means."""
+    vector: the data axis on a flat mesh; on a tree mesh the REVERSED axis
+    tuple — innermost-major, the reverse of the batch entry. The tree
+    sharded update produces exactly this block order: each reduce-scatter
+    (innermost level first) hands a device its coordinate's slice of the
+    remaining vector and the top hop's re-split hands it the outermost
+    coordinate's sub-slice, so device ``(a_0, .., a_k)`` owns flat block
+    ``a_k`` most-significant down to ``a_0`` least — which is what a dim
+    split over ``reversed(names)`` means (two-level: block ``d*H + h``,
+    the PR-13 layout, unchanged)."""
     names = tuple(mesh.axis_names)
     if len(names) == 1:
         return names[0]
-    return (names[1], names[0])
+    return tuple(reversed(names))
 
 
 def probe_link_bandwidth(
-    mesh: Mesh, floats_per_device: int = 1 << 18, reps: int = 3, tracer=None
+    mesh: Mesh,
+    floats_per_device: int = 1 << 18,
+    reps: int = 3,
+    tracer=None,
+    gate_ratio: float = 0.95,
 ) -> Dict[str, object]:
-    """Tiny per-link bandwidth probe of a two-level mesh (ISSUE 12): time the
-    three phases of the hierarchical combine standalone — a full-precision
-    reduce-scatter over DEVICE_AXIS (ICI), a psum over HOST_AXIS on the
-    scattered chunk (the DCN hop), and the all-gather back — and derive
-    bytes/s per link class from the logical per-device payload. The engine
-    gates ``--grad_comm hier`` on the ratio when ``--dcn_bandwidth_probe`` is
-    set (a mesh whose "DCN" is as fast as its ICI — one host, or a CPU test
-    mesh — gains nothing from the extra hops and falls back to flat).
+    """Tiny per-link bandwidth probe of a tree mesh (ISSUE 12, N-level since
+    ISSUE 17): time the three phases of the tree combine standalone — the
+    full-precision reduce-scatter cascade over the inner axes (ICI and
+    friends), a psum over the OUTERMOST axis on the scattered chunk (the DCN
+    hop), and the all-gather cascade back — and derive bytes/s per link
+    class from the logical per-device payload. Additionally measures each
+    LEVEL's link rate in isolation (one psum per axis on the chunk payload,
+    ``level_bytes_per_s`` outermost-first) — the signal the per-hop codec
+    chooser (``parallel/wire.py choose_wires``) and the learned topology
+    clustering consume. The engine gates ``--grad_comm hier`` on the wall
+    ratio when ``--dcn_bandwidth_probe`` is set (a mesh whose "DCN" is as
+    fast as its ICI — one host, or a CPU test mesh — gains nothing from the
+    extra hops and falls back to flat); ``gate_ratio`` is the required
+    margin (``--dcn_probe_gate``): hier must beat ``gate_ratio * flat``.
 
     Each phase runs under its own graftscope span (``comm_reduce_scatter`` /
     ``comm_dcn`` / ``comm_gather``, cat="comm") so a traced run shows the
@@ -159,11 +190,16 @@ def probe_link_bandwidth(
         from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
 
         tracer = get_tracer()
-    h_ax, d_ax = mesh.axis_names
-    n_h, n_d = mesh.shape[h_ax], mesh.shape[d_ax]
+    names = tuple(mesh.axis_names)
+    sizes = tuple(int(mesh.shape[a]) for a in names)
+    inner_axes = names[1:]
+    n_h = sizes[0]
+    n_d = 1  # product of the inner levels: the "devices per host" class
+    for s in sizes[1:]:
+        n_d *= s
     n = n_h * n_d
     c = -(-floats_per_device // n_d) * n_d  # per-device payload, RS-divisible
-    both = (h_ax, d_ax)
+    both = names
     sh = NamedSharding(mesh, P(both))
 
     def _program(body):
@@ -187,11 +223,20 @@ def probe_link_bandwidth(
     # for a timing probe, check_vma off)
     x_full = _payload(n * c)
     x_chunk = _payload(n * (c // n_d))
-    rs = _program(
-        lambda v: jax.lax.psum_scatter(v, d_ax, scatter_dimension=0, tiled=True)
-    )
-    dcn = _program(lambda v: jax.lax.psum(v, h_ax))
-    ag = _program(lambda v: jax.lax.all_gather(v, d_ax, tiled=True))
+
+    def _rs_body(v):
+        for a in reversed(inner_axes):  # innermost first, as the tree walks
+            v = jax.lax.psum_scatter(v, a, scatter_dimension=0, tiled=True)
+        return v
+
+    def _ag_body(v):
+        for a in inner_axes:
+            v = jax.lax.all_gather(v, a, tiled=True)
+        return v
+
+    rs = _program(_rs_body)
+    dcn = _program(lambda v: jax.lax.psum(v, names[0]))
+    ag = _program(_ag_body)
 
     def timed(name: str, fn, x) -> float:
         jax.block_until_ready(fn(x))  # compile + warm
@@ -219,15 +264,32 @@ def probe_link_bandwidth(
     hier_wall = sum(walls.values())
     ici_wall = 0.5 * (walls["comm_reduce_scatter"] + walls["comm_gather"])
     chunk_bytes = (c // n_d) * 4
+    # Per-LEVEL isolated link rates on the same chunk payload: one psum per
+    # axis, so differences between entries are link speed, not payload. This
+    # is what choose_wires / TopologyTree.learned consume.
+    level_walls = [
+        timed(
+            f"comm_level_{a}",
+            _program(lambda v, a=a: jax.lax.psum(v, a)),
+            x_chunk,
+        )
+        for a in names
+    ]
     return {
         "ici_bytes_per_s": (c * 4) / max(ici_wall, 1e-9),
         "dcn_bytes_per_s": chunk_bytes / max(walls["comm_dcn"], 1e-9),
+        "level_bytes_per_s": [
+            chunk_bytes / max(w, 1e-9) for w in level_walls
+        ],
+        "levels": [[a, int(s)] for a, s in zip(names, sizes)],
         "phase_s": {k: round(v, 6) for k, v in walls.items()},
         "flat_wall_s": round(flat_wall, 6),
         "hier_wall_s": round(hier_wall, 6),
         # hier must beat flat with margin at FULL precision structure; the
         # compressed wire only widens its win (fewer DCN bytes)
-        "hier_wins": bool(hier_wall < 0.95 * flat_wall),
+        "hier_wins": bool(hier_wall < gate_ratio * flat_wall),
+        "gate_ratio": float(gate_ratio),
+        "wall_ratio": round(hier_wall / max(flat_wall, 1e-9), 4),
         "hosts": int(n_h),
         "devices_per_host": int(n_d),
     }

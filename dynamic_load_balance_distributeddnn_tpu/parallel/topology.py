@@ -48,6 +48,200 @@ def factor_hosts(devices: Sequence, requested: int = 0) -> Optional[int]:
     return len(by_proc)
 
 
+def parse_hier_levels(spec: str) -> Tuple[Tuple[str, int], ...]:
+    """Parse a declared topology spec (``--hier_levels host:4,rack:2``) into
+    ``((name, size), ...)`` outermost-first. Raises ValueError on malformed
+    entries — the config validator calls this so a typo dies at parse time,
+    not at mesh-build time."""
+    levels: List[Tuple[str, int]] = []
+    seen = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(
+                f"hier_levels entry {part!r} must be name:size (e.g. host:4)"
+            )
+        name, _, size_s = part.partition(":")
+        name = name.strip()
+        try:
+            size = int(size_s)
+        except ValueError:
+            raise ValueError(f"hier_levels size {size_s!r} is not an integer")
+        if not name or name in seen:
+            raise ValueError(f"hier_levels names must be unique, got {name!r}")
+        if size < 2:
+            raise ValueError(f"hier_levels size for {name!r} must be >= 2")
+        seen.add(name)
+        levels.append((name, size))
+    return tuple(levels)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyTree:
+    """An N-level factorization of the mesh-ordered device list into nested
+    contiguous groups — the structure the tree collective walks (ISSUE 17,
+    after DynamiQ's multi-hop all-reduce).
+
+    ``levels`` is ``((name, size), ...)`` OUTERMOST-first: ``levels[0]`` is
+    the slowest link class (the one compressed hardest), the last level the
+    fastest (in-host ICI; its hop always runs at fp32). The product of every
+    level's size times the implicit innermost remainder equals the device
+    count; ``tree_mesh`` reshapes devices row-major so the flat device
+    numbering (and every per-device rng fold) is unchanged vs the flat mesh.
+
+    Three ways to get one:
+
+    * ``declared(spec, n)`` — the ``--hier_levels host:4,rack:2`` string;
+    * ``from_process_topology(devices, requested)`` — the PR-12 two-level
+      host/device split (real process blocks, or a synthetic
+      ``--hier_hosts`` count);
+    * ``learned(probe)`` — cluster a bandwidth probe's per-level bytes/s and
+      merge adjacent levels whose measured rates are indistinguishable (the
+      structure was not worth a hop).
+
+    ``restrict(n)`` re-derives the tree over a survivor count at an elastic
+    re-shard: outer levels that still divide the fleet are kept, levels that
+    no longer fit are dropped (absorbed into their inner neighbour), so a
+    churned fleet keeps whatever hierarchy remains instead of the old
+    all-or-nothing equal-host-blocks-or-flat fallback."""
+
+    levels: Tuple[Tuple[str, int], ...]  # outermost-first, innermost LAST
+
+    def __post_init__(self):
+        if len(self.levels) < 2:
+            raise ValueError("TopologyTree needs >= 2 levels (else run flat)")
+        names = [n for n, _ in self.levels]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate level names: {names}")
+        for name, size in self.levels:
+            if size < 2:
+                raise ValueError(f"level {name!r} size {size} < 2")
+
+    # ------------------------------------------------------------ accessors
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.levels)
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(s for _, s in self.levels)
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for _, s in self.levels:
+            n *= s
+        return n
+
+    def key(self) -> Tuple:
+        """Hashable identity for signatures/registry keys."""
+        return tuple(self.levels)
+
+    # --------------------------------------------------------- construction
+
+    @classmethod
+    def declared(cls, spec: str, n_devices: int) -> Optional["TopologyTree"]:
+        """Build from a ``--hier_levels`` string over ``n_devices``. The
+        declared levels are OUTER levels; the innermost "device" level is
+        implicit and absorbs the remainder. Returns None when the declared
+        product does not divide the device count (the caller logs and runs
+        flat) — a malformed string raises instead (config bug, not fleet
+        shape)."""
+        declared = parse_hier_levels(spec)
+        if not declared:
+            return None
+        outer = 1
+        for _, s in declared:
+            outer *= s
+        if outer > n_devices or n_devices % outer:
+            return None
+        remainder = n_devices // outer
+        if remainder >= 2:
+            inner_name = "device" if "device" not in {n for n, _ in declared} else "chip"
+            levels = declared + ((inner_name, remainder),)
+        else:
+            levels = declared
+        if len(levels) < 2:
+            return None
+        return cls(levels)
+
+    @classmethod
+    def from_process_topology(
+        cls, devices: Sequence, requested: int = 0
+    ) -> Optional["TopologyTree"]:
+        """The PR-12 two-level host/device split: real contiguous process
+        blocks, or a synthetic ``requested`` host count (``--hier_hosts``)."""
+        hosts = factor_hosts(devices, requested)
+        if hosts is None:
+            return None
+        per = len(devices) // hosts
+        if per < 2:
+            # one device per "host": a single level — no tree to walk
+            return None
+        return cls((("host", hosts), ("device", per)))
+
+    @classmethod
+    def learned(
+        cls,
+        candidate: "TopologyTree",
+        level_bytes_per_s: Sequence[float],
+        merge_ratio: float = 2.0,
+    ) -> Optional["TopologyTree"]:
+        """Cluster a candidate tree's levels by MEASURED per-level link rate
+        (``probe_link_bandwidth``'s ``level_bytes_per_s``, outermost-first):
+        adjacent levels whose rates are within ``merge_ratio`` of each other
+        are the same link class — the extra hop buys no codec distinction, so
+        they merge (sizes multiply, the faster neighbour's name wins). Rates
+        that are unmeasured/non-positive inhibit merging (keep the declared
+        structure rather than guess). Returns None when everything merges
+        into one level (a symmetric fabric — run flat)."""
+        if len(level_bytes_per_s) != len(candidate.levels):
+            raise ValueError("one measured rate per candidate level")
+        merged: List[Tuple[str, int, float]] = []
+        for (name, size), rate in zip(candidate.levels, level_bytes_per_s):
+            r = float(rate) if rate and rate > 0 else 0.0
+            if merged:
+                pname, psize, prate = merged[-1]
+                if prate > 0 and r > 0 and max(prate, r) / min(prate, r) < merge_ratio:
+                    # same link class: collapse the hop (inner name wins —
+                    # it is the axis the combined level actually spans)
+                    merged[-1] = (name, psize * size, max(prate, r))
+                    continue
+            merged.append((name, size, r))
+        if len(merged) < 2:
+            return None
+        return cls(tuple((n, s) for n, s, _ in merged))
+
+    # -------------------------------------------------------------- elastic
+
+    def restrict(self, n_devices: int) -> Optional["TopologyTree"]:
+        """Re-derive the tree over a survivor fleet: walk outermost-to-
+        innermost keeping every level whose size still divides the remaining
+        device count; a level that no longer fits is dropped (its structure
+        is gone from the fleet). The innermost kept level absorbs whatever
+        quotient remains. Returns None when fewer than two levels survive —
+        the caller falls back to the flat combine."""
+        if n_devices < 4:
+            return None
+        kept: List[Tuple[str, int]] = []
+        remaining = n_devices
+        for name, size in self.levels[:-1]:
+            if remaining % size == 0 and remaining // size >= 2:
+                kept.append((name, size))
+                remaining //= size
+        if remaining >= 2:
+            inner_name = self.levels[-1][0]
+            if any(n == inner_name for n, _ in kept):
+                inner_name = inner_name + "_r"
+            kept.append((inner_name, remaining))
+        if len(kept) < 2:
+            return None
+        return TopologyTree(tuple(kept))
+
+
 @dataclasses.dataclass(frozen=True)
 class WorkerTopology:
     world_size: int

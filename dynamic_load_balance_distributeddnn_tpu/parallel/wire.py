@@ -103,6 +103,149 @@ def quantize_nearest(v: jnp.ndarray, scale, levels: int) -> jnp.ndarray:
     )
 
 
+def tree_hop_widths(
+    n_elems: int, sizes: Tuple[int, ...], pad_multiple: int = 0
+) -> Tuple[int, ...]:
+    """Per-hop payload widths (f32 elements per participant) of the tree
+    spine, outermost-first: ``widths[i]`` is the length of the vector that
+    crosses hop ``i``, ``widths[-1]`` the full padded tree and ``widths[0]``
+    the top chunk each device carries across the slowest link. Shared by the
+    residual allocator (one row-block per hop 0..k-1), the engine's
+    bytes-on-wire accounting and the bench — one formula, no drift.
+
+    ``pad_multiple`` raises the padding granularity (the ZeRO-1 composition
+    pads the raveled tree to a multiple of the WHOLE device count so the
+    final per-device slice is rectangular); it must itself be a multiple of
+    the inner group product."""
+    inner = 1
+    for s in sizes[1:]:
+        inner *= s
+    m = max(int(pad_multiple), inner)
+    if m % inner:
+        raise ValueError(f"pad_multiple {pad_multiple} not a multiple of {inner}")
+    padded = -(-n_elems // m) * m
+    widths = []
+    div = 1
+    for s in reversed(sizes[1:]):
+        widths.append(padded // div)  # innermost..: width entering hop i
+        div *= s
+    widths.append(padded // div)  # hop 0 (the top chunk)
+    return tuple(reversed(widths))
+
+
+# Modeled quantize/dequant memory passes per wire, priced at the fastest
+# link's rate (a memory-bandwidth proxy). int4 carries an extra ACCURACY tax
+# on top of its real two passes: round-to-nearest is biased, so it should
+# only win when the link is so slow that halving int8's payload dominates
+# (~20x asymmetry at the default weights; int8 needs ~6x to beat fp32).
+_WIRE_COST_PASSES = {"fp32": 0.0, "int8": 3.0, "int4": 8.0}
+
+
+def choose_wires(
+    sizes: Tuple[int, ...], level_bytes_per_s
+) -> Tuple[str, ...]:
+    """Per-hop codec choice from MEASURED link rates (the bandwidth probe's
+    ``level_bytes_per_s``, outermost-first) against a bytes-vs-quantization
+    cost model: hop ``i``'s modeled per-element cost is
+
+        payload_bytes(wire, sizes[i]) / rate_i  +  passes(wire) * 4 / rate_ref
+
+    and the cheapest wire wins (ties resolve toward less compression). The
+    innermost hop is ALWAYS fp32 — it is the fastest link by construction
+    and keeping it exact is what bounds the residual set to hops 0..k-1.
+    Unmeasured/non-positive rates degrade to fp32 for that hop (never guess
+    a codec from missing data). Deterministic: same rates, same tree, same
+    codecs on every process."""
+    rates = [float(r) if r and float(r) > 0 else 0.0 for r in level_bytes_per_s]
+    if len(rates) != len(sizes):
+        raise ValueError("one measured rate per level")
+    r_ref = max(rates) if rates else 0.0
+    out = []
+    for i, (s, r) in enumerate(zip(sizes, rates)):
+        if i == len(sizes) - 1 or r <= 0.0 or r_ref <= 0.0:
+            out.append("fp32")
+            continue
+        out.append(
+            min(
+                WIRE_FORMATS,
+                key=lambda w: wire_payload_bytes(w, s) / r
+                + _WIRE_COST_PASSES[w] * 4.0 / r_ref,
+            )
+        )
+    return tuple(out)
+
+
+def tree_allreduce(
+    grads,
+    key,
+    names: Tuple[str, ...],
+    sizes: Tuple[int, ...],
+    wires: Tuple[str, ...],
+    residuals=None,
+):
+    """The N-level combine spine (inside a shard_map body; ISSUE 17, after
+    DynamiQ's compressed multi-hop all-reduce). ``names``/``sizes``/``wires``
+    are the topology tree's levels OUTERMOST-first (``wires[i]`` is hop i's
+    codec; the innermost hop must be fp32 — enforce, don't trust).
+
+    Ravel the gradient tree ONCE, then:
+
+    * **up** — reduce-scatter over the innermost axis at full precision,
+      then one error-fed compressed reduce-scatter per middle level
+      (each halves-or-better the bytes ON that level's link and divides the
+      payload by the level size), and finally one compressed all-reduce
+      across the outermost (slowest) axis;
+    * **down** — all-gather back through levels 1..k in order, inverting the
+      scatters (each gather re-concatenates the chunks the matching scatter
+      dealt, so the flat layout reconstructs exactly).
+
+    ``residuals`` is None or a tuple with one per-hop row for hops 0..k-1
+    (``tree_hop_widths`` gives the lengths); the return's second element is
+    the matching tuple of new residuals (identically zero on fp32 hops, so
+    the state layout is codec-independent). Per-hop dither keys fold the hop
+    index so no two compressed hops share a rounding field.
+
+    With two levels and ``wires=(w, "fp32")`` this IS the PR-12 spine,
+    bit-for-bit at the fp32 wire. Shared verbatim by
+    StepLibrary._hier_combine (production) and the grad_comm bench."""
+    import jax.flatten_util
+
+    k = len(names) - 1
+    if k < 1 or len(sizes) != k + 1 or len(wires) != k + 1:
+        raise ValueError("tree_allreduce needs >= 2 aligned levels")
+    if wires[-1] != "fp32":
+        raise ValueError(
+            f"innermost hop must ride the fp32 wire, got {wires[-1]!r} "
+            "(residuals exist only for hops 0..k-1)"
+        )
+    flat, unravel = jax.flatten_util.ravel_pytree(grads)
+    t_real = flat.size
+    inner = 1
+    for s in sizes[1:]:
+        inner *= s
+    padded = -(-t_real // inner) * inner
+    v = jnp.pad(flat, (0, padded - t_real))
+    # up: innermost hop, exact
+    v = jax.lax.psum_scatter(v, names[k], scatter_dimension=0, tiled=True)
+    new_res = [None] * k
+    for i in range(k - 1, 0, -1):  # middle hops, error-fed reduce-scatter
+        vi = v + (residuals[i] if residuals is not None else 0.0)
+        v, sent = compressed_reduce_scatter_ef(
+            vi, jax.random.fold_in(key, i), names[i], sizes[i], wires[i]
+        )
+        new_res[i] = vi - sent
+    v0 = v + (residuals[0] if residuals is not None else 0.0)
+    total, sent = compressed_reduce(
+        v0, jax.random.fold_in(key, 0), names[0], sizes[0], wires[0]
+    )
+    new_res[0] = v0 - sent
+    # down: gathers invert the scatters last-to-first
+    out = total
+    for i in range(1, k + 1):
+        out = jax.lax.all_gather(out, names[i], tiled=True)
+    return unravel(out[:t_real]), tuple(new_res)
+
+
 def hier_tree_allreduce(
     grads,
     key,
@@ -113,46 +256,39 @@ def hier_tree_allreduce(
     wire: str,
     residual=None,
 ):
-    """The two-level combine spine (inside a shard_map body): ravel the
-    gradient tree ONCE, reduce-scatter in-host at full precision, cross
-    hosts on one compressed hop, all-gather back, unravel. Returns
-    ``(reduced tree, new residual chunk)``. Shared verbatim by
-    StepLibrary._hier_combine (production) and the grad_comm bench (so the
-    bench times exactly the shipped collective)."""
-    import jax.flatten_util
-
-    flat, unravel = jax.flatten_util.ravel_pytree(grads)
-    t_real = flat.size
-    padded = -(-t_real // n_devices_per_host) * n_devices_per_host
-    flat = jnp.pad(flat, (0, padded - t_real))
-    g_chunk = jax.lax.psum_scatter(
-        flat, device_axis, scatter_dimension=0, tiled=True
+    """The PR-12 two-level combine, now a thin delegate onto the N-level
+    :func:`tree_allreduce` (one spine, no parallel implementations): in-host
+    fp32 reduce-scatter, ONE compressed cross-host hop, in-host all-gather.
+    Returns ``(reduced tree, new residual chunk)``."""
+    out, res = tree_allreduce(
+        grads,
+        key,
+        (host_axis, device_axis),
+        (n_hosts, n_devices_per_host),
+        (wire, "fp32"),
+        (residual,) if residual is not None else None,
     )
-    v = g_chunk + (residual if residual is not None else 0.0)
-    total, sent = compressed_reduce(v, key, host_axis, n_hosts, wire)
-    new_residual = v - sent
-    out = jax.lax.all_gather(total, device_axis, tiled=True)
-    return unravel(out[:t_real]), new_residual
+    return out, res[0]
 
 
-def compressed_reduce_scatter(
+def compressed_reduce_scatter_ef(
     v: jnp.ndarray,
     key,
     axis: AxisName,
     n_participants: int,
     wire: str,
-) -> jnp.ndarray:
-    """One compressed reduce-scatter hop over ``axis`` (inside shard_map):
-    the ZeRO-1 sharded update's gradient collective riding the quantized
-    wire (PR-12 follow-up). Quantize with the shared ``pmax`` scale,
-    reduce-scatter the integer payload in the wire's sum dtype — the same
-    bytes-per-element shrink as :func:`compressed_reduce`, on 1/n of the
-    tensor per link — and dequantize this participant's chunk of the sum.
-    ``v``'s leading dim must divide by the axis size (the caller's ZeRO-1
-    padding guarantees it). The int8 wire's stochastic rounding keeps the
-    scattered sum unbiased exactly like the all-reduce hop."""
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One compressed reduce-scatter hop over ``axis`` (inside shard_map),
+    with the error-feedback contract of :func:`compressed_reduce`: returns
+    ``(scattered_sum, sent)`` where ``sent`` is THIS participant's
+    dequantized contribution (full pre-scatter width — the caller's residual
+    is ``v - sent``, zero for fp32). ``v``'s leading dim must divide by the
+    axis size (the callers' tree/ZeRO-1 padding guarantees it)."""
     if wire == "fp32":
-        return jax.lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)
+        return (
+            jax.lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True),
+            v,
+        )
     levels = _LEVELS[wire]
     amax = jax.lax.pmax(jnp.max(jnp.abs(v)), axis)
     scale = jnp.maximum(amax / levels, jnp.finfo(jnp.float32).tiny)
@@ -166,7 +302,21 @@ def compressed_reduce_scatter(
         scatter_dimension=0,
         tiled=True,
     )
-    return s.astype(jnp.float32) * scale
+    return s.astype(jnp.float32) * scale, q.astype(jnp.float32) * scale
+
+
+def compressed_reduce_scatter(
+    v: jnp.ndarray,
+    key,
+    axis: AxisName,
+    n_participants: int,
+    wire: str,
+) -> jnp.ndarray:
+    """The residual-free reduce-scatter hop (the flat ZeRO-1 path's gradient
+    collective riding the quantized wire): :func:`compressed_reduce_scatter_ef`
+    without the error-feedback return — the int8 wire's stochastic rounding
+    keeps the scattered sum unbiased with no residual needed."""
+    return compressed_reduce_scatter_ef(v, key, axis, n_participants, wire)[0]
 
 
 def compressed_reduce(

@@ -178,6 +178,12 @@ PROTOCOL = {
             "writers": ("RendezvousStateMachine.offer_join",),
             "tolerate": "missing-or-torn",
         },
+        "probe": {
+            "pattern": "probe_g{gen}_p{ident}.json",
+            "format": "json",
+            "writers": ("RendezvousStateMachine.publish_probe",),
+            "tolerate": "missing-or-torn",
+        },
         "done": {
             "pattern": "done_p{ident}",
             "format": "marker",
@@ -384,6 +390,7 @@ def reset_rendezvous_dir(rdzv_dir: str) -> int:
         "ack_g*.json",
         "loss_g*.json",
         "propose_g*.json",
+        "probe_g*.json",
         "torn_g*",
         "done_p*",
         "join_p*.json",
@@ -715,6 +722,66 @@ class RendezvousStateMachine:
             if info is not None:
                 out.update(int(d) for d in info.get("dead", ()))
         return out
+
+    # ------------------------------------------------------- probe exchange
+
+    def publish_probe(self, costs: Dict[int, float]) -> None:
+        """Publish this process's measured per-worker compute costs
+        (seconds/example, keyed by ORIGINAL worker rank) for the CURRENT
+        generation — the grow-path share-seeding exchange (ISSUE 17): after
+        a join rendezvous every member publishes what it measured locally
+        and reads everyone else's, so survivors and the joiner all seed the
+        SAME equilibrium share vector instead of guessing the joiner in at
+        the survivor mean. Gen-tagged like every consensus file (a stale
+        generation's costs must never seed a newer fleet) and atomic like
+        every JSON write. An empty map is a valid publication: "I measured
+        nothing" is itself the signal peers must not wait on."""
+        _write_json(
+            os.path.join(
+                self.rdzv_dir, f"probe_g{self.gen}_p{self.ident}.json"
+            ),
+            {
+                "ident": self.ident,
+                "costs": {str(r): float(c) for r, c in costs.items()},
+            },
+        )
+
+    def collect_probes(
+        self, procs: Iterable[int], timeout_s: Optional[float] = None
+    ) -> Optional[Dict[int, float]]:
+        """Read every listed process's probe publication for the CURRENT
+        generation, waiting (bounded, ``DBS_RDZV_PROBE_S``) for stragglers.
+        Returns the merged rank -> cost map only when EVERY process's file
+        arrived — a partial exchange returns None and the caller keeps its
+        deterministic fallback seeding: all members must assemble the
+        identical vector or none of them use the exchange."""
+        if timeout_s is None:
+            timeout_s = _env_timeout("DBS_RDZV_PROBE_S", 20.0)
+        want = sorted(int(p) for p in procs)
+        merged: Dict[int, float] = {}
+        got: Set[int] = set()
+        deadline = time.monotonic() + timeout_s
+        last_tick = 0.0
+        while True:
+            for p in want:
+                if p in got:
+                    continue
+                info = _read_json(
+                    os.path.join(self.rdzv_dir, f"probe_g{self.gen}_p{p}.json")
+                )
+                if info is not None:
+                    got.add(p)
+                    for r, c in (info.get("costs") or {}).items():
+                        merged[int(r)] = float(c)
+            if len(got) == len(want):
+                return merged
+            now = time.monotonic()
+            if now >= deadline:
+                return None
+            if now - last_tick >= _TICK_EVERY_S:
+                last_tick = now
+                self.tick()
+            time.sleep(_POLL_S)
 
     # ----------------------------------------------------------- consensus
 

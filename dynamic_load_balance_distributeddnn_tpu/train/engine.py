@@ -228,80 +228,95 @@ class Trainer:
             for p in sorted(by_proc):
                 proc_devs = sorted(by_proc[p], key=lambda d: d.id)
                 mesh_devices.extend(proc_devs[i] for i in used)
-        # Hierarchical ICI/DCN combine (ISSUE 12): resolve --grad_comm hier
-        # into a two-level (host, device) mesh when the device list factors
-        # into host groups (real process topology, or the synthetic
-        # --hier_hosts split on CPU tiers). self.grad_comm is the RUNTIME
-        # choice — "flat" whenever no factorization exists or the bandwidth
-        # probe says the fabric gains nothing — and everything downstream
+        # Tree gradient combine (ISSUE 12, N-level since ISSUE 17): resolve
+        # --grad_comm hier into an N-level topology mesh when the device
+        # list factors into a TopologyTree — declared (--hier_levels),
+        # derived from the real process topology / synthetic --hier_hosts
+        # split, or probe-learned. self.grad_comm is the RUNTIME choice —
+        # "flat" whenever no factorization exists or the bandwidth probe
+        # says the fabric gains nothing — and everything downstream
         # (StepLibrary axes, combine dispatch, AOT keys, bytes-on-wire
         # accounting) keys off it, never off cfg.grad_comm.
         self.grad_comm = "flat"
         self._hier_hosts = 0
+        self._topo_tree = None
+        self._grad_comm_wires: tuple = ()
         self._link_bw: Optional[Dict] = None
-        # bandwidth-probe verdict memo: a reshard's host re-factor must not
-        # re-enable a structure the probe measured as a loss on this fabric
+        # bandwidth-probe verdict memo: a reshard's tree re-derivation must
+        # not re-enable a structure the probe measured as a loss here
         self._probe_gated_flat = False
         if cfg.grad_comm == "hier":
-            from dynamic_load_balance_distributeddnn_tpu.parallel.topology import (
-                factor_hosts,
-            )
-
-            hosts = factor_hosts(mesh_devices, requested=cfg.hier_hosts)
-            if hosts is None:
+            tree, learn = self._resolve_topology_tree(mesh_devices)
+            if tree is None:
                 self.logger.warning(
-                    "grad_comm=hier: no (host, device) factorization of "
+                    "grad_comm=hier: no topology-tree factorization of "
                     f"{len(mesh_devices)} devices "
-                    f"(hier_hosts={cfg.hier_hosts}, processes={self.n_proc})"
+                    f"(hier_levels={cfg.hier_levels!r}, "
+                    f"hier_hosts={cfg.hier_hosts}, processes={self.n_proc})"
                     " — falling back to the flat combine"
                 )
             else:
                 self.grad_comm = "hier"
-                self._hier_hosts = hosts
+                self._topo_tree = tree
+                self._hier_hosts = tree.sizes[0]
         if self.grad_comm == "hier":
             from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
-                hier_mesh,
                 probe_link_bandwidth,
+                tree_mesh,
             )
 
-            self.mesh = hier_mesh(mesh_devices, self._hier_hosts)
-            # The three-phase probe always runs on a SINGLE-PROCESS hier
-            # mesh — its comm_reduce_scatter/comm_dcn/comm_gather spans and
-            # per-link bytes/s are the run's comm observability — but it
-            # only GATES (falls back to flat) when the operator opted in:
-            # forced hier on a deliberately synthetic split (tests, the
-            # bench) must stay hier. Multi-host runs skip it entirely: the
-            # probe device_puts host-local arrays onto the global mesh
+            self.mesh = tree_mesh(
+                mesh_devices, self._topo_tree.names, self._topo_tree.sizes
+            )
+            # The bandwidth probe always runs on a SINGLE-PROCESS tree
+            # mesh — its per-phase spans and per-level bytes/s are the
+            # run's comm observability, the input of the learned-tree
+            # merge and the per-hop codec choice — but it only GATES
+            # (falls back to flat) when the operator opted in: forced hier
+            # on a deliberately synthetic split (tests, the bench) must
+            # stay hier. Multi-host runs skip it entirely: the probe
+            # device_puts host-local arrays onto the global mesh
             # (non-addressable from any one process), and a per-process
             # wall-clock verdict could DIVERGE across hosts — half the
-            # fleet on a 2-D mesh, half flat, deadlocked at the first
+            # fleet on a tree mesh, half flat, deadlocked at the first
             # collective. Real pods trust --grad_comm until the probe
             # learns a replicated decision channel (ROADMAP).
             if self.n_proc == 1:
-                self._link_bw = probe_link_bandwidth(self.mesh)
+                self._link_bw = probe_link_bandwidth(
+                    self.mesh, gate_ratio=cfg.dcn_probe_gate
+                )
                 heartbeat()
-            elif cfg.dcn_bandwidth_probe:
+                if learn:
+                    self._learn_tree_from_probe(mesh_devices)
+            elif cfg.dcn_bandwidth_probe or learn:
                 self.logger.warning(
-                    "dcn_bandwidth_probe is single-process-only today — "
+                    "the bandwidth probe is single-process-only today — "
                     "keeping grad_comm=hier as configured"
                 )
-            if cfg.dcn_bandwidth_probe and self._link_bw is not None:
-                if not self._link_bw["hier_wins"]:
-                    self.logger.warning(
-                        "grad_comm=hier: bandwidth probe measured the "
-                        "three-phase hier structure at "
-                        f"{self._link_bw['hier_wall_s']:.4f}s vs "
-                        f"{self._link_bw['flat_wall_s']:.4f}s for one flat "
-                        "psum (no slow DCN link to shorten) — falling back "
-                        "to the flat combine"
-                    )
-                    self.grad_comm = "flat"
-                    self._hier_hosts = 0
-                    self._probe_gated_flat = True
-                    self.mesh = data_mesh(mesh_devices)
-        else:
+            if (
+                cfg.dcn_bandwidth_probe
+                and self.grad_comm == "hier"
+                and self._link_bw is not None
+                and not self._link_bw["hier_wins"]
+            ):
+                self.logger.warning(
+                    "grad_comm=hier: bandwidth probe measured the tree "
+                    "structure at "
+                    f"{self._link_bw['hier_wall_s']:.4f}s vs "
+                    f"{self._link_bw['flat_wall_s']:.4f}s for one flat "
+                    f"psum (ratio {self._link_bw['wall_ratio']:.3f}, gate "
+                    f"{cfg.dcn_probe_gate}) — falling back to the flat "
+                    "combine"
+                )
+                self.grad_comm = "flat"
+                self._hier_hosts = 0
+                self._topo_tree = None
+                self._probe_gated_flat = True
+                self.mesh = data_mesh(mesh_devices)
+        if self.grad_comm != "hier" and getattr(self, "mesh", None) is None:
             self.mesh = data_mesh(mesh_devices)
         self.n_dev = len(mesh_devices)
+        self._grad_comm_wires = self._resolve_wires()
         # AOT-key / plan-layout signature of the combine structure: a new
         # axis factorization or wire format is a new compiled-program
         # universe, so it participates in every registry key the combine
@@ -433,6 +448,10 @@ class Trainer:
         if self.grad_comm == "hier":
             self.recorder.meta["grad_comm_wire"] = cfg.grad_comm_wire
             self.recorder.meta["grad_comm_hosts"] = self._hier_hosts
+            self.recorder.meta["grad_comm_levels"] = [
+                [n, int(s)] for n, s in self._topo_tree.levels
+            ]
+            self.recorder.meta["grad_comm_wires"] = list(self._grad_comm_wires)
         if self._link_bw is not None:
             self.recorder.meta["link_bandwidth"] = {
                 k: v for k, v in self._link_bw.items()
@@ -711,6 +730,7 @@ class Trainer:
             remat=cfg.remat,
             grad_comm=self.grad_comm,
             grad_comm_wire=cfg.grad_comm_wire,
+            grad_comm_wires=self._grad_comm_wires or None,
             zero1_padded=getattr(self, "_zero1_padded", 0),
         )
         if getattr(self, "_aot", None) is not None:
@@ -819,10 +839,14 @@ class Trainer:
         flat: the full f32 tree rides every link it spans — ICI always, DCN
         only when the mesh actually crosses hosts (real processes; a
         single-process synthetic split has no DCN and records 0).
-        hier: reduce-scatter + all-gather keep 2x the tree on ICI at full
-        precision, and only the 1/D chunk crosses DCN in the wire's sum
-        dtype (parallel/wire.py wire_payload_bytes)."""
+        hier: the innermost reduce-scatter + all-gather keep 2x the tree
+        on ICI at full precision; each middle hop adds its shrinking
+        vector on that hop's wire (up) plus f32 back (down) to the ICI
+        class; only the top-hop chunk crosses DCN in the outermost wire's
+        sum dtype (parallel/wire.py wire_payload_bytes). On a two-level
+        tree this reduces exactly to the PR-12 numbers."""
         from dynamic_load_balance_distributeddnn_tpu.parallel.wire import (
+            tree_hop_widths,
             wire_payload_bytes,
         )
 
@@ -832,14 +856,20 @@ class Trainer:
             )
         elems = self._param_elems
         if self.grad_comm == "hier":
-            n_d = self.n_dev // max(self._hier_hosts, 1)
-            chunk = -(-elems // n_d)
-            dcn = chunk * wire_payload_bytes(
-                self.cfg.grad_comm_wire, self._hier_hosts
-            )
-            # one device per host: the in-host reduce-scatter/all-gather
-            # are identities — no ICI traffic to account
-            ici = 2 * elems * 4 if n_d > 1 else 0
+            sizes = self._topo_tree.sizes
+            wires = self._grad_comm_wires
+            # pad_multiple=0: the LOGICAL payload accounting (identical to
+            # the PR-12 numbers); the zero-1 layout pads slightly wider but
+            # the padding is zeros, not signal
+            widths = tree_hop_widths(elems, sizes, pad_multiple=0)
+            dcn = widths[0] * wire_payload_bytes(wires[0], sizes[0])
+            # innermost hop: full-tree f32 reduce-scatter + all-gather
+            ici = 2.0 * elems * 4
+            # middle hops 1..k-1: the hop's vector on its wire up, f32 down
+            for i in range(1, len(sizes) - 1):
+                ici += widths[i] * (
+                    wire_payload_bytes(wires[i], sizes[i]) + 4
+                )
             return float(ici), float(dcn)
         # flat: compress_grads rides its own int16 wire (half the f32 bytes)
         per_elem = 2 if self.cfg.compress_grads == "int8" else 4
@@ -847,6 +877,45 @@ class Trainer:
             float(elems * per_elem),
             float(elems * per_elem if self.n_proc > 1 else 0),
         )
+
+    def _modeled_comm_step_s(self) -> float:
+        """Modeled wall of ONE gradient combine over the probe's measured
+        per-level link rates (ISSUE 17): each hop's bytes (the same per-hop
+        accounting as :meth:`_comm_bytes_per_step`) divided by that level's
+        measured bytes/s, summed — hops serialize along the tree spine.
+        Feeds the window controller's ``comm_step_s`` so the rebalance
+        hysteresis sees the comm floor a compute rebalance cannot touch.
+        0.0 whenever there is no resolved tree or no probe data (the
+        compute-only model — never guess a wall from missing rates)."""
+        if self.grad_comm != "hier" or self._topo_tree is None:
+            return 0.0
+        rates = (self._link_bw or {}).get("level_bytes_per_s")
+        sizes = self._topo_tree.sizes
+        wires = self._grad_comm_wires
+        if not rates or len(rates) != len(sizes) or len(wires) != len(sizes):
+            return 0.0
+        r = [float(x) if x and float(x) > 0 else 0.0 for x in rates]
+        if any(x <= 0.0 for x in r):
+            return 0.0
+        from dynamic_load_balance_distributeddnn_tpu.parallel.wire import (
+            tree_hop_widths,
+            wire_payload_bytes,
+        )
+
+        if not hasattr(self, "_param_elems"):
+            self._param_elems = int(
+                sum(p.size for p in jax.tree_util.tree_leaves(self.state.params))
+            )
+        elems = self._param_elems
+        widths = tree_hop_widths(elems, sizes, pad_multiple=0)
+        k = len(sizes) - 1
+        total = 2.0 * elems * 4 / r[k]  # innermost f32 RS + AG
+        for i in range(1, k):  # middle hops: wire up, f32 down
+            total += widths[i] * (
+                wire_payload_bytes(wires[i], sizes[i]) + 4
+            ) / r[i]
+        total += widths[0] * wire_payload_bytes(wires[0], sizes[0]) / r[0]
+        return float(total)
 
     def _aot_resolve(self, kind: str, b: int, d: int, win: Optional[int], fallback):
         """Compiled executable for a dispatch site, or the lazy jit
@@ -1669,6 +1738,26 @@ class Trainer:
         template_fn = None
         if self.cfg.elastic == "on" and self.cfg.shard_update:
             template_fn = self._zero1_restore_template
+        # a respawned JOINER entering the grown world (DBS_MH_IDENT marks
+        # it): measure our own ranks' per-example costs on their local
+        # devices (no collectives) and publish them into the grow
+        # rendezvous's probe exchange BEFORE the restore barrier both sides
+        # synchronize on — the survivors publish theirs at the matching
+        # point in _mh_rerendezvous, so after the restore every publication
+        # is on disk and both sides collect the identical set (ISSUE 17)
+        joiner = (
+            self.cfg.elastic == "on"
+            and self.n_proc > 1
+            and self._rdzv is not None
+            and os.environ.get("DBS_MH_IDENT") is not None
+        )
+        if joiner:
+            own_costs = {}
+            for r in self._ranks_of_proc(self._orig_proc_id):
+                c = self._probe_local_cost(int(r))
+                if c is not None:
+                    own_costs[int(r)] = float(c)
+            self._publish_probe_costs(own_costs)
         restored = restore_checkpoint(
             self.cfg.ckpt_dir, self.state, template_fn=template_fn
         )
@@ -1732,6 +1821,13 @@ class Trainer:
                     f"entries) do not match the stamped fleet "
                     f"({len(base)}) — resetting to uniform"
                 )
+            if joiner:
+                # upgrade the sidecar-derived seed to the equilibrium of the
+                # exchanged probe costs (ISSUE 17). The restore above was a
+                # global barrier, so every process's probe file is on disk;
+                # collect is all-or-nothing, so an incomplete exchange keeps
+                # the identical sidecar vectors on every process instead
+                self._collect_probe_seed()
             if "total_wallclock" in controller:
                 self.total_wallclock = float(controller["total_wallclock"])
             if "total_probe_s" in controller:
@@ -2199,14 +2295,145 @@ class Trainer:
             leaves.append(leaf)
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
+    def _resolve_topology_tree(self, mesh_devices):
+        """Resolve the combine's TopologyTree over ``mesh_devices``:
+        declared (--hier_levels), else the two-level host/device split
+        (real process topology or the synthetic --hier_hosts count).
+        Returns ``(tree or None, learn)`` where ``learn`` says the
+        operator asked for the probe-driven level merge ("learned"
+        prefix)."""
+        from dynamic_load_balance_distributeddnn_tpu.parallel.topology import (
+            TopologyTree,
+        )
+
+        cfg = self.cfg
+        spec = cfg.hier_levels.strip()
+        learn = False
+        if spec == "learned" or spec.startswith("learned,"):
+            learn = True
+            spec = spec[len("learned"):].lstrip(",")
+        tree = None
+        if spec:
+            tree = TopologyTree.declared(spec, len(mesh_devices))
+            if tree is None:
+                self.logger.warning(
+                    f"hier_levels={spec!r} does not factor "
+                    f"{len(mesh_devices)} devices — trying the two-level "
+                    "host/device split"
+                )
+        if tree is None:
+            tree = TopologyTree.from_process_topology(
+                mesh_devices, requested=cfg.hier_hosts
+            )
+        return tree, learn
+
+    def _learn_tree_from_probe(self, mesh_devices) -> None:
+        """Probe-driven level merge (--hier_levels learned...): collapse
+        adjacent tree levels whose measured link rates are the same class,
+        rebuild the mesh on the merged tree, and RE-PROBE it so
+        ``_link_bw``'s per-level rates align with the final structure (the
+        per-hop codec choice and the gate verdict read them). A merge down
+        to one level means the fabric is symmetric — fall back flat."""
+        # mesh rebuild below: drain any concurrent topology readers first
+        # (G019 quiesce discipline; a no-op at __init__ time, when this
+        # runs before the first pipeline exists)
+        self._quiesce_pipeline()
+        from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
+            data_mesh,
+            probe_link_bandwidth,
+            tree_mesh,
+        )
+        from dynamic_load_balance_distributeddnn_tpu.parallel.topology import (
+            TopologyTree,
+        )
+
+        rates = (self._link_bw or {}).get("level_bytes_per_s")
+        if not rates or len(rates) != len(self._topo_tree.levels):
+            return
+        merged = TopologyTree.learned(self._topo_tree, rates)
+        if merged is None:
+            self.logger.warning(
+                "hier_levels=learned: every level measured as the same "
+                "link class (symmetric fabric) — falling back to the flat "
+                "combine"
+            )
+            self.grad_comm = "flat"
+            self._hier_hosts = 0
+            self._topo_tree = None
+            self._probe_gated_flat = True
+            self.mesh = data_mesh(mesh_devices)
+            return
+        if merged.levels != self._topo_tree.levels:
+            self.logger.info(
+                f"hier_levels=learned: merged {self._topo_tree.levels} "
+                f"-> {merged.levels} from measured link rates"
+            )
+            self._topo_tree = merged
+            self._hier_hosts = merged.sizes[0]
+            self.mesh = tree_mesh(mesh_devices, merged.names, merged.sizes)
+            self._link_bw = probe_link_bandwidth(
+                self.mesh, gate_ratio=self.cfg.dcn_probe_gate
+            )
+            heartbeat()
+
+    def _resolve_wires(self) -> tuple:
+        """Per-hop wire codecs for the CURRENT tree, outermost hop first —
+        one entry per mesh level, innermost fp32. Sources, in order:
+        explicit --grad_comm_wires list (must match the level count),
+        "auto" (parallel/wire.py choose_wires over the probe's measured
+        per-level rates), else the legacy default (--grad_comm_wire on the
+        outermost hop, fp32 below)."""
+        if self.grad_comm != "hier":
+            return ()
+        cfg = self.cfg
+        sizes = self._topo_tree.sizes
+        k = len(sizes)
+        spec = cfg.grad_comm_wires.strip()
+        if spec == "auto":
+            rates = (self._link_bw or {}).get("level_bytes_per_s")
+            if rates and len(rates) == k:
+                from dynamic_load_balance_distributeddnn_tpu.parallel.wire import (
+                    choose_wires,
+                )
+
+                wires = choose_wires(sizes, rates)
+                self.logger.info(
+                    f"grad_comm_wires=auto: {dict(zip(self._topo_tree.names, wires))} "
+                    "from measured link rates"
+                )
+                return wires
+            self.logger.warning(
+                "grad_comm_wires=auto needs the bandwidth probe's "
+                "per-level rates (single-process probe) — using the "
+                "legacy default"
+            )
+            spec = ""
+        if spec:
+            wires = tuple(w.strip() for w in spec.split(","))
+            if len(wires) == k:
+                return wires
+            self.logger.warning(
+                f"grad_comm_wires={spec!r} has {len(wires)} entries but "
+                f"the resolved tree has {k} levels — using the legacy "
+                "default"
+            )
+        return (cfg.grad_comm_wire,) + ("fp32",) * (k - 1)
+
     def _compute_comm_sig(self) -> tuple:
         """AOT-key / plan-layout signature of the combine structure (see the
         __init__ comment) — recomputed on every fleet change: an elastic
-        re-shard can re-factor hier hosts or fall back to flat, and the two
+        re-shard can re-derive the tree or fall back to flat, and two
         structures lower different programs that must never resolve to each
-        other."""
+        other. The hier signature is the full tree with each hop's wire:
+        one (name, size, wire) triple per level, outermost first."""
         return (
-            ("hier", self.cfg.grad_comm_wire, self._hier_hosts)
+            ("hier",)
+            + tuple(
+                (name, size, wire)
+                for (name, size), wire in zip(
+                    self._topo_tree.levels, self._grad_comm_wires
+                )
+            )
             if self.grad_comm == "hier"
             else ("flat",)
         ) + (("zero1",) if self.cfg.shard_update else ())
@@ -2293,41 +2520,59 @@ class Trainer:
                 [used.index(i) for i in ids_active],
             )
             mesh_devices = list(self.topology.devices)
-        # hier×elastic (ISSUE 14 satellite): re-factor the survivors into
-        # host groups so elastic runs KEEP the two-level combine when the
-        # surviving devices still form equal contiguous host blocks (real
-        # process topology, or the synthetic --hier_hosts split); otherwise
-        # fall back to the flat combine — logged once, and the re-keyed
-        # _comm_sig makes the structure change a new compiled-program
-        # universe (no hier executable can resolve against a flat world).
+        # hier×elastic (ISSUE 14 satellite, tree-aware since ISSUE 17):
+        # re-derive the topology tree over the survivors so elastic runs
+        # KEEP whatever hierarchy remains — TopologyTree.restrict walks
+        # the previous tree keeping every level that still divides the
+        # fleet (the old all-or-nothing equal-host-blocks-or-flat
+        # fallback is the degenerate case); on real multi-host fleets the
+        # host level re-derives from the SURVIVING process topology
+        # instead (the host axis must align with real process blocks).
+        # Otherwise fall back to the flat combine — logged once, and the
+        # re-keyed _comm_sig makes the structure change a new
+        # compiled-program universe (no hier executable can resolve
+        # against a flat world).
         prev_comm = self.grad_comm
+        prev_tree = self._topo_tree
         self.grad_comm = "flat"
         self._hier_hosts = 0
+        self._topo_tree = None
         if cfg.grad_comm == "hier" and not self._probe_gated_flat:
             from dynamic_load_balance_distributeddnn_tpu.parallel.topology import (
-                factor_hosts,
+                TopologyTree,
             )
 
-            hosts = factor_hosts(mesh_devices, requested=cfg.hier_hosts)
-            if hosts is not None:
+            if self.n_proc > 1 and not cfg.hier_levels:
+                tree = TopologyTree.from_process_topology(
+                    mesh_devices, requested=0
+                )
+            elif prev_tree is not None:
+                tree = prev_tree.restrict(len(mesh_devices))
+            else:
+                tree, _ = self._resolve_topology_tree(mesh_devices)
+            if tree is not None:
                 self.grad_comm = "hier"
-                self._hier_hosts = hosts
+                self._topo_tree = tree
+                self._hier_hosts = tree.sizes[0]
             else:
                 self.logger.warning(
                     f"grad_comm=hier: the {len(mesh_devices)}-device survivor "
-                    "fleet no longer factors into equal contiguous host "
-                    "blocks — falling back to the flat combine"
+                    "fleet keeps no topology-tree structure (fewer than two "
+                    "divisible levels) — falling back to the flat combine"
                     + (" (was hier)" if prev_comm == "hier" else "")
                 )
         if self.grad_comm == "hier":
             from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
-                hier_mesh,
+                tree_mesh,
             )
 
-            self.mesh = hier_mesh(mesh_devices, self._hier_hosts)
+            self.mesh = tree_mesh(
+                mesh_devices, self._topo_tree.names, self._topo_tree.sizes
+            )
         else:
             self.mesh = data_mesh(mesh_devices)
         self.n_dev = len(mesh_devices)
+        self._grad_comm_wires = self._resolve_wires()
         self._comm_sig = self._compute_comm_sig()
         if cfg.shard_update:
             # the 1/N optimizer chunk layout is sized by the DEVICE count:
@@ -2621,10 +2866,13 @@ class Trainer:
     def _maybe_regrow_multihost(self, epoch: int) -> None:
         """Epoch-boundary grow: (re)spawned processes that offered to join
         (``join_p*.json`` + a fresh beacon) are admitted by re-running the
-        same rendezvous with them in the roster. Newcomers seed at the
-        survivor-mean share (a cross-process probe exchange is a recorded
-        follow-up); their engine restores from the shared checkpoint and
-        adopts the agreed fleet."""
+        same rendezvous with them in the roster. Every process publishes its
+        own ranks' carried per-example costs into the rendezvous probe
+        exchange before the restore barrier, so newcomers seed at the
+        equilibrium share of the exchanged costs (falling back to the
+        sidecar-derived mean fill when the exchange is incomplete); their
+        engine restores from the shared checkpoint and adopts the agreed
+        fleet."""
         if self._rdzv is None:
             return
         alive = self._rdzv.alive_procs()
@@ -2785,6 +3033,19 @@ class Trainer:
             self.n_proc = len(roster)
             self.proc_id = agreement.rank
             self._proc_roster = roster
+            if joining:
+                # grow-path probe exchange (ISSUE 17): publish OUR ranks'
+                # carried costs now — BEFORE the restore barrier both sides
+                # synchronize on — so every member's publication is on disk
+                # by the time anyone collects (step 8 here; the joiner's
+                # _maybe_restore publishes its measured costs symmetrically)
+                own_costs: Dict[int, float] = {}
+                for r in self._ranks_of_proc(self._orig_proc_id):
+                    if r in prev_active:
+                        own_costs[r] = float(
+                            np.asarray(src["cost"])[prev_active.index(r)]
+                        )
+                self._publish_probe_costs(own_costs)
             restored_from = "epoch snapshot"
             ctl = None
             rebuild_err: Optional[Exception] = None
@@ -2891,6 +3152,12 @@ class Trainer:
                 self._adopt_controller_vectors(
                     prev_active, src["shares"], src["node_times"], src["cost"]
                 )
+            # grow path: upgrade the mean-fill seed to the equilibrium split
+            # over the exchanged per-worker costs (identical on every
+            # process when the exchange completes; the mean-fill above
+            # stands — identically everywhere — when it does not)
+            if joining:
+                self._collect_probe_seed()
             for p in joining:
                 for r in self._ranks_of_proc(p):
                     self.health.readmit(r)
@@ -3129,6 +3396,113 @@ class Trainer:
                 "the survivor mean"
             )
             return None
+
+    def _probe_local_cost(self, r: int) -> Optional[float]:
+        """Per-example cost of OUR OWN original worker rank ``r`` from one
+        timed probe step on its LOCAL device — the multi-host twin of
+        :meth:`_probe_readmitted`, restricted to process-local puts (a
+        cross-process ``shard_views`` put would run a hidden collective the
+        peers are not pairing). None under a deterministic timing model, on
+        a non-local rank, or on probe failure — the probe exchange then
+        publishes nothing for this rank and every process falls back
+        identically."""
+        if self.timing_model is not None:
+            return None
+        try:
+            if r not in self.active_ranks:
+                return None
+            i = self.active_ranks.index(r)
+            d = next(
+                di
+                for di, group in self.topology.groups.items()
+                if i in group
+            )
+            dev = self.topology.devices[d]
+            if dev.process_index != jax.process_index():
+                return None
+            b = max(self.cfg.bucket, 1)
+            x, y, w = self._dummy_batch(b)
+            params = jax.tree_util.tree_map(
+                lambda p: jax.device_put(jax.device_get(p), dev),
+                self.state.params,
+            )
+            args = (
+                jax.device_put(x, dev),
+                jax.device_put(y, dev),
+                jax.device_put(w, dev),
+                jax.device_put(jax.random.PRNGKey(0), dev),
+                jax.device_put(jnp.int32(0), dev),
+            )
+            fn = self.steps.worker_step_first
+            _, aux = fn(params, *args)
+            jax.block_until_ready(aux)  # warm (compile) untimed
+            heartbeat()
+            dt = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                _, aux = fn(params, *args)
+                jax.block_until_ready(aux)
+                dt = min(dt, time.perf_counter() - t0)
+            heartbeat()
+            return max(dt, 1e-9) / b
+        except Exception as e:  # noqa: BLE001 — seeding is best-effort
+            self.logger.warning(
+                f"elastic: local probe for rank {r} failed ({e!r}) — "
+                "publishing no cost for it"
+            )
+            return None
+
+    def _publish_probe_costs(self, costs: Dict[int, float]) -> None:
+        """Publish this process's finite positive per-rank costs into the
+        grow-rendezvous probe exchange (rendezvous.py ``publish_probe``);
+        an empty publication is deliberate — peers must not wait on a
+        process that measured nothing."""
+        if self._rdzv is None:
+            return
+        self._rdzv.publish_probe(
+            {
+                int(r): float(c)
+                for r, c in costs.items()
+                if np.isfinite(c) and float(c) > 0.0
+            }
+        )
+
+    def _collect_probe_seed(self) -> bool:
+        """GROW-path share seeding (ISSUE 17): read every roster member's
+        probe publication and seed the equilibrium split from the union —
+        a pure function of the collected files, so survivors and the
+        joiner derive IDENTICAL vectors (the replicated-controller
+        contract the survivor-mean guess used to satisfy trivially).
+        False — keep the sidecar-derived mean-fill seeding — when the
+        exchange misses a member inside the bounded window or the union
+        leaves any worker's cost unknown."""
+        if self._rdzv is None:
+            return False
+        merged = self._rdzv.collect_probes(self._proc_roster)
+        if merged is None:
+            self.logger.warning(
+                "elastic: probe exchange incomplete — keeping the "
+                "survivor-mean seed for joined workers"
+            )
+            return False
+        cost = np.full(self.world_size, np.nan)
+        for i, r in enumerate(self.active_ranks):
+            c = merged.get(int(r))
+            if c is not None and np.isfinite(c) and c > 0.0:
+                cost[i] = c
+        if not np.isfinite(cost).all():
+            return False
+        self.per_example_cost = cost
+        self.shares = equilibrium_shares(cost)
+        # t_i = c_i * p_i: seed the times consistently with the shares so
+        # the next rebalance is a fixed point of the exchanged estimate
+        self.node_times = np.maximum(cost * self.shares, 1e-9)
+        self.logger.info(
+            "elastic: probe exchange seeded equilibrium shares "
+            f"{np.round(self.shares, 4).tolist()} over "
+            f"{len(self._proc_roster)} process(es)"
+        )
+        return True
 
     def _maybe_warm(self) -> None:
         if self.cfg.warm_start and not self._warmed:
@@ -4372,6 +4746,9 @@ class Trainer:
             # decision journal on the registry snapshot (ISSUE 15): the
             # controller's ledgers + last verdict become queryable live
             self.obs.attach(controller=self._rebalance_ctl)
+        # refresh each call: the tree/wires (and therefore the modeled comm
+        # floor) can change across re-resolutions while the controller lives
+        self._rebalance_ctl.comm_step_s = self._modeled_comm_step_s()
         return self._rebalance_ctl
 
     def _window_rates(self) -> Optional[np.ndarray]:

@@ -134,6 +134,7 @@ class LMTrainer(Trainer):
             remat=cfg.remat,
             grad_comm=self.grad_comm,
             grad_comm_wire=cfg.grad_comm_wire,
+            grad_comm_wires=self._grad_comm_wires or None,
             zero1_padded=self._zero1_padded,
         )
 

@@ -31,15 +31,20 @@ class TrainState:
     params: Any
     opt_state: Any
     step: jnp.ndarray  # global step counter
-    # Error-feedback residual of the hierarchical compressed gradient
-    # collective (ISSUE 12): each device's accumulated quantization error on
-    # its reduce-scattered chunk, [n_devices, chunk] sharded one row per
-    # device over the two-level mesh. None (an empty pytree subtree — no
-    # leaf, no signature change) on every non-hierarchical run; attached by
-    # attach_comm_residual when --grad_comm hier resolves. Carried in the
-    # state so it donates/checkpoints/restores with the weights — dropping
-    # it between steps would silently discard the compression error the
-    # biased wires (int4) rely on re-injecting.
+    # Error-feedback residuals of the tree compressed gradient collective
+    # (ISSUE 12, N-level since ISSUE 17): a TUPLE with one row-block per
+    # hop 0..k-1 of the topology tree (every hop except the innermost
+    # always-fp32 one), outermost hop first. Entry i is [n_devices, W_i]
+    # f32 — each device's accumulated quantization error on the vector it
+    # carries across hop i (widths from parallel/wire.py tree_hop_widths) —
+    # sharded one row per device over the tree mesh. fp32 hops keep their
+    # entry (identically zero), so the state layout is codec-independent.
+    # None (an empty pytree subtree — no leaf, no signature change) on every
+    # non-hierarchical run; attached by attach_comm_residual when
+    # --grad_comm hier resolves. Carried in the state so it donates/
+    # checkpoints/restores with the weights — dropping it between steps
+    # would silently discard the compression error the biased wires (int4)
+    # rely on re-injecting.
     comm_residual: Any = None
 
     def learning_rate(self) -> float:
@@ -145,14 +150,15 @@ def shard_optimizer_state(
 def residual_chunk_size(
     params, devices_per_host: int, pad_multiple: int = 0
 ) -> int:
-    """Per-device error-feedback chunk width: the raveled param count padded
-    up to a multiple of the in-host device count (the reduce-scatter's
-    divisibility requirement) — or of ``pad_multiple`` when the ZeRO-1
-    layout co-rides the combine (the sharded update pads to the TOTAL
-    device count so the post-hop chunk re-splits evenly across hosts) —
-    divided by the in-host count. Must match the hierarchical combine's
-    padding arithmetic (parallel/wire.py hier_tree_allreduce and the
-    sharded twin in train/steps.py)."""
+    """Per-device error-feedback chunk width of the TOP hop (kept for the
+    two-level callers/tests): the raveled param count padded up to a
+    multiple of the in-host device count (the reduce-scatter's divisibility
+    requirement) — or of ``pad_multiple`` when the ZeRO-1 layout co-rides
+    the combine (the sharded update pads to the TOTAL device count so the
+    post-hop chunk re-splits evenly across hosts) — divided by the in-host
+    count. The N-level generalization is
+    ``parallel/wire.py tree_hop_widths`` (this is its ``widths[0]`` for a
+    two-level tree)."""
     total = zero1_param_count(params)
     mult = max(pad_multiple, devices_per_host)
     padded = -(-total // mult) * mult
@@ -160,24 +166,34 @@ def residual_chunk_size(
 
 
 def attach_comm_residual(state: TrainState, mesh, pad_multiple: int = 0) -> TrainState:
-    """Attach a zero error-feedback residual sized for ``mesh``'s two-level
-    factorization: [n_devices, chunk] f32, one row per device (leading axis
-    split over BOTH mesh axes, row-major — the flat device order).
+    """Attach zero error-feedback residuals sized for ``mesh``'s tree
+    factorization (>= 2 levels): a tuple with one [n_devices, W_i] f32
+    row-block per hop 0..k-1, outermost hop first (widths from
+    ``tree_hop_widths`` — the innermost hop is always fp32 and carries no
+    residual). Each block's leading axis splits over ALL mesh axes,
+    row-major — one row per device in the flat device order.
     ``pad_multiple``: the ZeRO-1 total-device padding when the sharded
-    update rides the wire (see :func:`residual_chunk_size`). Fresh runs
-    start at zero error by definition; checkpoint restore replaces the
-    zeros with the saved residual through the ordinary state template."""
+    update rides the wire. Fresh runs start at zero error by definition;
+    checkpoint restore replaces the zeros with the saved residuals through
+    the ordinary state template."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    names = tuple(mesh.axis_names)
-    if len(names) != 2:
-        raise ValueError("attach_comm_residual needs a two-level (host, device) mesh")
-    n = int(np.prod(tuple(mesh.shape.values())))
-    chunk = residual_chunk_size(
-        state.params, int(mesh.shape[names[1]]), pad_multiple
+    from dynamic_load_balance_distributeddnn_tpu.parallel.wire import (
+        tree_hop_widths,
     )
-    residual = jax.device_put(
-        jnp.zeros((n, chunk), jnp.float32), NamedSharding(mesh, P(names))
+
+    names = tuple(mesh.axis_names)
+    if len(names) < 2:
+        raise ValueError("attach_comm_residual needs a tree mesh (>= 2 levels)")
+    sizes = tuple(int(mesh.shape[a]) for a in names)
+    n = int(np.prod(sizes))
+    widths = tree_hop_widths(
+        zero1_param_count(state.params), sizes, pad_multiple
+    )
+    sh = NamedSharding(mesh, P(names))
+    residual = tuple(
+        jax.device_put(jnp.zeros((n, w), jnp.float32), sh)
+        for w in widths[:-1]  # hops 0..k-1; the innermost fp32 hop has none
     )
     return state.replace(comm_residual=residual)
 

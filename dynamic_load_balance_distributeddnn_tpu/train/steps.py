@@ -83,27 +83,54 @@ class StepLibrary:
         remat: bool = False,
         grad_comm: str = "flat",
         grad_comm_wire: str = "int8",
+        grad_comm_wires: Optional[Tuple[str, ...]] = None,
         zero1_padded: int = 0,
     ):
         self.spec = spec
         self.mesh = mesh
         self.tx = tx
-        # Hierarchical ICI/DCN gradient collective (ISSUE 12): on a
-        # two-level ("host", "device") mesh, the combine reduce-scatters
-        # in-host over ICI at full precision, crosses hosts on the
-        # compressed grad_comm_wire (parallel/wire.py) with error-feedback
-        # residuals carried in the TrainState, and all-gathers back. "flat"
-        # keeps the one-psum combine (the only choice on a 1-D mesh).
+        # Tree gradient collective (ISSUE 12, N-level since ISSUE 17): on a
+        # >=2-level topology mesh (parallel/topology.py TopologyTree), the
+        # combine reduce-scatters up the tree — fp32 over the innermost
+        # (fastest) axis, then one hop per outer level on that hop's wire
+        # codec (parallel/wire.py tree_allreduce) with per-hop
+        # error-feedback residuals carried in the TrainState — and
+        # all-gathers back down. "flat" keeps the one-psum combine (the
+        # only choice on a 1-D mesh).
         self.grad_comm = grad_comm
         self.grad_comm_wire = grad_comm_wire
         self.axes = tuple(mesh.axis_names)
-        self.hier = grad_comm == "hier" and len(self.axes) == 2
-        if grad_comm == "hier" and len(self.axes) != 2:
+        self.hier = grad_comm == "hier" and len(self.axes) >= 2
+        if grad_comm == "hier" and len(self.axes) < 2:
             raise ValueError(
-                "grad_comm='hier' needs a two-level (host, device) mesh "
-                "(parallel/mesh.py hier_mesh); the engine resolves the "
+                "grad_comm='hier' needs a tree mesh with >= 2 levels "
+                "(parallel/mesh.py tree_mesh); the engine resolves the "
                 "factorization and falls back to flat when none exists"
             )
+        # Per-hop wire codecs, outermost hop first, one per mesh level; the
+        # innermost hop is structurally fp32 (it is the reduce-scatter the
+        # residual layout assumes error-free). Default: the legacy single
+        # grad_comm_wire on the outermost (slowest) hop, fp32 below — the
+        # exact PR-12 two-level behaviour on a two-level mesh.
+        if grad_comm_wires:
+            wires = tuple(grad_comm_wires)
+        else:
+            wires = (grad_comm_wire,) + ("fp32",) * max(len(self.axes) - 1, 0)
+        if self.hier:
+            if len(wires) != len(self.axes):
+                raise ValueError(
+                    f"grad_comm_wires needs one codec per mesh level: got "
+                    f"{len(wires)} for axes {self.axes}"
+                )
+            if wires[-1] != "fp32":
+                raise ValueError(
+                    "the innermost tree hop must be fp32 (parallel/wire.py "
+                    "tree_allreduce carries no residual for it)"
+                )
+            for w in wires:
+                if w not in wirefmt.WIRE_FORMATS:
+                    raise ValueError(f"unknown wire codec {w!r}")
+        self.grad_comm_wires = wires
         self.mean = mean
         self.std = std
         self.augment = augment
@@ -173,6 +200,7 @@ class StepLibrary:
         *,
         hier: bool = False,
         wire: str = "fp32",
+        wires: Optional[Tuple[str, ...]] = None,
         compress: str = "",
     ) -> "StepLibrary":
         """A minimal library exposing ONLY the ZeRO-1 update spine —
@@ -189,6 +217,11 @@ class StepLibrary:
         lib.zero1_padded = int(zero1_padded)
         lib.compress_grads = compress
         lib.grad_comm_wire = wire
+        lib.grad_comm_wires = (
+            tuple(wires)
+            if wires
+            else (wire,) + ("fp32",) * max(len(lib.axes) - 1, 0)
+        )
         lib._state_donate = ()
         return lib
 
@@ -583,10 +616,10 @@ class StepLibrary:
     def aot_lowerables(self) -> Dict[str, Callable]:
         out = {}
         if self.hier:
-            # hier combine twins exist only on the two-level mesh (building
-            # them on a flat mesh would trace collectives over axes the
-            # mesh does not define); with shard_update on they ARE the
-            # sharded-update twins (the body routes)
+            # hier combine twins exist only on a tree mesh (>= 2 levels —
+            # building them on a flat mesh would trace collectives over
+            # axes the mesh does not define); with shard_update on they
+            # ARE the sharded-update twins (the body routes)
             out["combine_update_hier"] = self.combine_update_hier
             out["combine_probe_hier"] = self.combine_probe_hier
         elif self.shard_update:
@@ -618,12 +651,12 @@ class StepLibrary:
     # single-device eval path)
 
     # -------------------------------------------------- mesh-axis plumbing
-    # The mesh is 1-D ("data") on flat runs and 2-D ("host", "device") when
-    # the hierarchical combine resolved. Every collective/spec in the fused
-    # bodies routes through these helpers so one code path serves both
-    # factorizations — on a flat mesh each helper degenerates to exactly
-    # the pre-hier spelling (same axis string, same lowering, bitwise-same
-    # programs).
+    # The mesh is 1-D ("data") on flat runs and an N-level topology tree
+    # (outermost axis first) when the tree combine resolved. Every
+    # collective/spec in the fused bodies routes through these helpers so
+    # one code path serves every factorization — on a flat mesh each
+    # helper degenerates to exactly the pre-hier spelling (same axis
+    # string, same lowering, bitwise-same programs).
 
     @property
     def _axis_arg(self):
@@ -648,44 +681,51 @@ class StepLibrary:
         return self._axis_arg
 
     def _data_axis_index(self):
-        """Flat device position inside a shard_map body: identical numbering
-        under both factorizations (row-major ``h*D + d``), so per-device rng
-        folds are invariant to the mesh shape."""
+        """Flat device position inside a shard_map body: the mixed-radix
+        fold of the per-axis indices, outermost axis most significant —
+        identical numbering under EVERY factorization (tree_mesh reshapes
+        row-major), so per-device rng folds are invariant to the mesh
+        shape."""
         if len(self.axes) == 1:
             return jax.lax.axis_index(self.axes[0])
-        h_ax, d_ax = self.axes
-        n_d = int(self.mesh.shape[d_ax])
-        return jax.lax.axis_index(h_ax) * n_d + jax.lax.axis_index(d_ax)
+        idx = jax.lax.axis_index(self.axes[0])
+        for a in self.axes[1:]:
+            idx = idx * int(self.mesh.shape[a]) + jax.lax.axis_index(a)
+        return idx
 
-    # -------------------------------------- hierarchical ICI/DCN combine
-    # (ISSUE 12, after DynamiQ's compressed multi-hop all-reduce): in-host
-    # reduce-scatter at full precision over the fast ICI axis, ONE
-    # compressed hop across the slow DCN axis on 1/D of the tree, in-host
-    # all-gather back. Error-feedback residuals (TrainState.comm_residual)
-    # make the biased wires convergent (parallel/wire.py).
+    # ------------------------------------------- tree gradient combine
+    # (ISSUE 12, N-level since ISSUE 17, after DynamiQ's compressed
+    # multi-hop all-reduce): reduce-scatter UP the topology tree — fp32
+    # over the innermost (fastest) axis, then one hop per outer level on
+    # that hop's wire codec, shrinking the vector by the level size each
+    # hop — and all-gather back DOWN. Per-hop error-feedback residuals
+    # (TrainState.comm_residual) make the biased wires convergent
+    # (parallel/wire.py).
 
     def _hier_combine(self, grads, rng, residual):
-        """Two-level gradient reduction inside a shard_map body.
+        """N-level tree gradient reduction inside a shard_map body.
 
         ``grads``: this device's local gradient tree. ``residual``: this
-        device's [1, chunk] error-feedback slice of
-        ``TrainState.comm_residual``. Returns ``(reduced grads tree,
-        new [1, chunk] residual)``. The tree is raveled ONCE so the whole
-        combine is three collectives regardless of leaf count (the flat
-        combine pays one psum per leaf); the spine itself lives in
-        parallel/wire.py so the grad_comm bench times the identical code."""
-        h_ax, d_ax = self.axes
-        out, new_residual = wirefmt.hier_tree_allreduce(
+        device's per-hop error-feedback rows — a tuple with one [1, W_i]
+        slice of ``TrainState.comm_residual`` per hop 0..k-1, outermost
+        first. Returns ``(reduced grads tree, new residual tuple)``. The
+        tree is raveled ONCE so the whole combine is 2k+1 collectives
+        regardless of leaf count (the flat combine pays one psum per
+        leaf); the spine itself lives in parallel/wire.py so the
+        grad_comm bench times the identical code."""
+        names = self.axes
+        sizes = tuple(int(self.mesh.shape[a]) for a in names)
+        out, new_residual = wirefmt.tree_allreduce(
             grads,
             rng,
-            h_ax,
-            d_ax,
-            int(self.mesh.shape[h_ax]),
-            int(self.mesh.shape[d_ax]),
-            self.grad_comm_wire,
-            residual=(residual[0] if residual is not None else None),
+            names,
+            sizes,
+            self.grad_comm_wires,
+            residuals=(
+                tuple(r[0] for r in residual) if residual is not None else None
+            ),
         )
-        return out, new_residual[None]
+        return out, tuple(r[None] for r in new_residual)
 
     @functools.cached_property
     def _opt_state_spec(self):
@@ -865,12 +905,14 @@ class StepLibrary:
         uniform even when data shards are not, which is why this composes
         with DBS).
 
-        Wire composition (PR-12 follow-up): on the two-level mesh the
-        reduce-scatter becomes the full-precision in-host reduce-scatter
-        plus ONE compressed cross-host hop on ``grad_comm_wire`` with the
-        error-feedback residual carried per-chunk; each host then keeps its
-        1/H sub-slice, so the chunk layout is device-major
-        (parallel/mesh.py zero1_chunk_axes). On the flat mesh,
+        Wire composition (PR-12, N-level since ISSUE 17): on a tree mesh
+        the reduce-scatter walks the tree — full-precision over the
+        innermost (fastest) axis, then one EF'd hop per outer level on
+        that hop's ``grad_comm_wires`` codec, the outermost hop a
+        compressed all-reduce of the top chunk; each device then keeps
+        its mixed-radix flat block (innermost axis most significant —
+        parallel/mesh.py zero1_chunk_axes), so the two-level layout
+        ``d*H + h`` is unchanged. On the flat mesh,
         ``compress_grads='int8'`` rides the quantized reduce-scatter
         (parallel/wire.py compressed_reduce_scatter). ``with_comm=False``
         builds the comm-free probe twin: same FLOPs shape, collectives
@@ -891,30 +933,56 @@ class StepLibrary:
         new_residual = state.comm_residual
         key = jax.random.fold_in(rng, 0x2E01)
         if self.hier:
-            h_ax, d_ax = self.axes
-            n_h = int(self.mesh.shape[h_ax])
-            h = jax.lax.axis_index(h_ax)
-            d = jax.lax.axis_index(d_ax)
-            off = (d * n_h + h) * chunk
+            names = self.axes
+            sizes = tuple(int(self.mesh.shape[a]) for a in names)
+            k = len(names) - 1
+            idxs = [jax.lax.axis_index(a) for a in names]
+            # same padding convention as attach_comm_residual(pad_multiple=n)
+            widths = wirefmt.tree_hop_widths(t_real, sizes, pad_multiple=n)
+            assert widths[-1] == padded, (widths, padded)
+            # this device's flat block: mixed-radix offset with the
+            # innermost axis most significant (zero1_chunk_axes order) —
+            # exactly where the scatter cascade below lands its chunk
+            off = idxs[0] * chunk
+            for i in range(1, k + 1):
+                off = off + idxs[i] * widths[i - 1]
             if with_comm:
-                # in-host reduce-scatter at full precision over ICI: device
-                # d holds the summed-in-host d-th 1/D slice [chunk_d]
-                g_cd = jax.lax.psum_scatter(
-                    flat_g, d_ax, scatter_dimension=0, tiled=True
+                # innermost reduce-scatter at full precision (ICI): the
+                # device's index along the fastest axis picks its
+                # widths[k-1] slice of the in-group sum
+                v = jax.lax.psum_scatter(
+                    flat_g, names[k], scatter_dimension=0, tiled=True
                 )
-                res = (
-                    state.comm_residual[0]
-                    if state.comm_residual is not None
-                    else 0.0
-                )
-                v = g_cd + res
+                res = state.comm_residual
+                new_rows = list(res) if res is not None else [None] * k
+                # middle hops k-1..1: EF'd compressed reduce-scatter on
+                # each hop's wire, vector shrinking by sizes[i] per hop
+                for i in range(k - 1, 0, -1):
+                    vi = v + (res[i][0] if res is not None else 0.0)
+                    v, sent = wirefmt.compressed_reduce_scatter_ef(
+                        vi,
+                        jax.random.fold_in(key, i),
+                        names[i],
+                        sizes[i],
+                        self.grad_comm_wires[i],
+                    )
+                    new_rows[i] = (vi - sent)[None]
+                # top hop: compressed all-reduce of the widths[0] chunk
+                v0 = v + (res[0][0] if res is not None else 0.0)
                 total, sent = wirefmt.compressed_reduce(
-                    v, key, h_ax, n_h, self.grad_comm_wire
+                    v0,
+                    jax.random.fold_in(key, 0),
+                    names[0],
+                    sizes[0],
+                    self.grad_comm_wires[0],
                 )
-                new_residual = (v - sent)[None]
-                # re-split across hosts: host h owns the h-th 1/H sub-slice
-                # of the fully reduced chunk — flat block (d*H + h)*chunk
-                g_chunk = jax.lax.dynamic_slice(total, (h * chunk,), (chunk,))
+                new_rows[0] = (v0 - sent)[None]
+                new_residual = tuple(new_rows)
+                # re-split across the top level: index a_0 owns the a_0-th
+                # 1/s_0 sub-slice of the fully reduced top chunk
+                g_chunk = jax.lax.dynamic_slice(
+                    total, (idxs[0] * chunk,), (chunk,)
+                )
             else:
                 g_chunk = jax.lax.dynamic_slice(flat_g, (off,), (chunk,))
         else:
@@ -936,13 +1004,13 @@ class StepLibrary:
         updates_chunk, opt_state = self.tx.update(g_chunk, opt, p_chunk)
         if with_comm:
             if self.hier:
-                # gather back in layout order: hosts first (rebuilds the
-                # in-host chunk_d), then devices (rebuilds the flat vector)
-                delta = jax.lax.all_gather(
-                    jax.lax.all_gather(updates_chunk, h_ax, tiled=True),
-                    d_ax,
-                    tiled=True,
-                )
+                # gather back in layout order, outermost axis first (each
+                # gather rebuilds the next-wider hop vector, inverting the
+                # scatter cascade LIFO), innermost last (rebuilds the flat
+                # vector)
+                delta = updates_chunk
+                for a in self.axes:
+                    delta = jax.lax.all_gather(delta, a, tiled=True)
             else:
                 delta = jax.lax.all_gather(
                     updates_chunk, self._axis_arg, tiled=True

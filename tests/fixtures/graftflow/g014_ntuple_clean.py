@@ -1,0 +1,39 @@
+"""G014 negatives for N-tuple collective-axis resolution (ISSUE 17): the
+tree combine's collectives run over 3- and 4-member axis tuples — every
+member defined by the N-level mesh — as call-site literals, through module
+constants, and through sub-tuple variable binds; all stay quiet with no
+per-fixture baseline."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DCN = "dcn"
+RACK = "rack"
+HOST = "host"
+DEVICE = "device"
+
+
+def make_mesh(devices):
+    return Mesh(
+        np.array(devices).reshape(2, 2, 2, -1), (DCN, RACK, HOST, DEVICE)
+    )
+
+
+def combine(tree):
+    # the flat twin of the tree combine: one psum over the FULL 4-tuple
+    return jax.lax.psum(tree, (DCN, RACK, HOST, DEVICE))
+
+
+def reduce_up(x):
+    inner = (RACK, HOST, DEVICE)  # the sub-tree below the top hop
+    return jax.lax.psum_scatter(x, inner, scatter_dimension=0, tiled=True)
+
+
+def top_hop(x):
+    return jax.lax.psum(x, ("dcn",))  # literal member of the declared tree
+
+
+def gather_down(x):
+    mid = (HOST, DEVICE)
+    return jax.lax.all_gather(x, mid, axis=0, tiled=True)

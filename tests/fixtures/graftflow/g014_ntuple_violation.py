@@ -1,0 +1,43 @@
+"""G014 seeds: N-tuple collective axes (ISSUE 17). The N-level tree combine
+spells its collectives over 3- and 4-member axis tuples; each shape below
+hides one member no mesh defines — exactly the spellings the generalized
+``tree_allreduce`` ships, so the resolver must walk tuples of ANY length,
+not just the two-level (host, device) pair.
+
+Shape 1: ``combine`` psums over the full 4-tuple with a typo'd middle
+member ("rak").
+
+Shape 2: ``reduce_up`` scatters over a 3-member sub-tuple bound to a
+variable that carries a stale axis name from the two-level era ("hosts").
+
+Shape 3: ``index`` reads ``axis_index`` of a level the tree was declared
+without.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DCN = "dcn"
+RACK = "rack"
+HOST = "host"
+DEVICE = "device"
+
+
+def make_mesh(devices):
+    return Mesh(
+        np.array(devices).reshape(2, 2, 2, -1), (DCN, RACK, HOST, DEVICE)
+    )
+
+
+def combine(tree):
+    return jax.lax.psum(tree, (DCN, "rak", HOST, DEVICE))  # typo'd member
+
+
+def reduce_up(x):
+    inner = ("hosts", HOST, DEVICE)  # stale two-level-era axis name
+    return jax.lax.psum_scatter(x, inner, scatter_dimension=0, tiled=True)
+
+
+def index(x):
+    return jax.lax.axis_index("pod") + x  # level never declared

@@ -499,6 +499,7 @@ def test_reset_rendezvous_dir_clears_stale_protocol_files(tmp_path):
         "torn_g2_p0",
         "done_p1",
         "join_p1.json",
+        "probe_g2_p0.json",
     ]
     keep = ["proc0.hb", "epoch1_p0.marker"]
     for name in stale + keep:
@@ -509,6 +510,36 @@ def test_reset_rendezvous_dir_clears_stale_protocol_files(tmp_path):
     sm = RendezvousStateMachine(str(tmp_path), ident=0)
     assert sm.current_roster() == []
     assert sm.gen == 0
+
+
+def test_probe_exchange_publish_collect_roundtrip(tmp_path):
+    """ISSUE 17 satellite: the joiner share-seeding exchange. Each process
+    publishes its own ranks' per-example costs under its current generation;
+    collect is all-or-nothing over the agreed roster — every listed
+    process's file (same gen) or None, so a partial exchange can never
+    seed divergent shares across the fleet."""
+    from dynamic_load_balance_distributeddnn_tpu.runtime.rendezvous import (
+        RendezvousStateMachine,
+    )
+
+    a = RendezvousStateMachine(str(tmp_path), ident=0)
+    b = RendezvousStateMachine(str(tmp_path), ident=1)
+    a.publish_probe({0: 0.002, 1: 0.004})
+    # incomplete: proc 1 has not published yet -> None, never a partial map
+    assert a.collect_probes([0, 1], timeout_s=0.2) is None
+    b.publish_probe({2: 0.008, 3: 0.016})
+    merged = a.collect_probes([0, 1], timeout_s=5.0)
+    assert merged == {0: 0.002, 1: 0.004, 2: 0.008, 3: 0.016}
+    # both sides assemble the identical vector from the same files
+    assert b.collect_probes([0, 1], timeout_s=5.0) == merged
+    # gen-tagged: a publication from an older generation is invisible to a
+    # machine that has moved on — stale costs cannot leak across worlds
+    b.gen = 3
+    assert b.collect_probes([0, 1], timeout_s=0.2) is None
+    b.publish_probe({2: 0.5})
+    a.gen = 3
+    a.publish_probe({})  # an empty cost map is still a valid publication
+    assert a.collect_probes([0, 1], timeout_s=5.0) == {2: 0.5}
 
 
 def test_preemption_injector_kill_respawn_roundtrip():

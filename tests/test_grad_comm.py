@@ -34,8 +34,12 @@ from dynamic_load_balance_distributeddnn_tpu.parallel.mesh import (
     mesh_batch_axes,
     probe_link_bandwidth,
     shard_map,
+    tree_mesh,
 )
-from dynamic_load_balance_distributeddnn_tpu.parallel.topology import factor_hosts
+from dynamic_load_balance_distributeddnn_tpu.parallel.topology import (
+    TopologyTree,
+    factor_hosts,
+)
 from dynamic_load_balance_distributeddnn_tpu.train import Trainer
 from dynamic_load_balance_distributeddnn_tpu.train.checkpoint import (
     flush_checkpoints,
@@ -296,10 +300,168 @@ def test_error_feedback_residual_checkpoint_roundtrip(bundle, tmp_path):
     assert restored is not None
     _epoch, state, _ctl = restored
     np.testing.assert_array_equal(np.asarray(state.comm_residual), saved)
-    # and the restored leaf is PLACED for the two-level mesh (one row per
-    # device), ready for the donating hot path
-    assert state.comm_residual.sharding.spec == P(("host", "device"))
+    # and the restored per-hop row-blocks are PLACED for the two-level mesh
+    # (one row per device), ready for the donating hot path
+    assert state.comm_residual[0].sharding.spec == P(("host", "device"))
     flush_checkpoints(close=True)
+
+
+# ------------------------------------------------- N-level tree (ISSUE 17)
+
+
+def test_topology_tree_units():
+    # declared: outer product must divide; implicit innermost remainder
+    t = TopologyTree.declared("pod:2,host:2", 8)
+    assert t.levels == (("pod", 2), ("host", 2), ("device", 2))
+    assert TopologyTree.declared("pod:3", 8) is None  # 8 % 3
+    assert TopologyTree.declared("pod:2", 8).levels == (
+        ("pod", 2), ("device", 4),
+    )
+    # restrict: keep outer levels that still divide, inner absorbs the rest
+    two = TopologyTree((("host", 2), ("device", 4)))
+    assert two.restrict(6).levels == (("host", 2), ("device", 3))
+    assert two.restrict(7) is None  # 7 % 2: no structure survives
+    assert t.restrict(4).levels == (("pod", 2), ("device", 2))
+    # learned: merge adjacent levels measured as the same link class
+    merged = TopologyTree.learned(t, [1e6, 0.9e6, 1e9])
+    assert merged.levels == (("host", 4), ("device", 2))
+    assert TopologyTree.learned(t, [1e9, 1e9, 1e9]) is None  # symmetric
+    # unmeasured rates inhibit merging
+    assert TopologyTree.learned(t, [0.0, 0.0, 0.0]).levels == t.levels
+
+
+def test_tree_hop_widths_and_choose_wires():
+    widths = wirefmt.tree_hop_widths(133, (2, 2, 2))
+    # padded to a multiple of prod(inner sizes)=4 -> 136
+    assert widths == (34, 68, 136)
+    assert wirefmt.tree_hop_widths(133, (2, 4), pad_multiple=8) == (34, 136)
+    # cost model: symmetric links keep fp32; a ~10x-slower top link buys
+    # int8; a ~100x-slower one buys int4; innermost is ALWAYS fp32
+    assert wirefmt.choose_wires((2, 2, 2), [1e9, 1e9, 1e9]) == (
+        "fp32", "fp32", "fp32",
+    )
+    assert wirefmt.choose_wires((2, 2, 2), [1e8, 1e9, 1e9]) == (
+        "int8", "fp32", "fp32",
+    )
+    assert wirefmt.choose_wires((2, 2, 2), [1e7, 1e8, 1e9]) == (
+        "int4", "int8", "fp32",
+    )
+    # unmeasured rate -> fp32 (no evidence, no compression)
+    assert wirefmt.choose_wires((2, 2), [0.0, 1e9]) == ("fp32", "fp32")
+
+
+def test_tree_allreduce_nlevel_fp32_bitwise_parity():
+    """Integer-valued gradients sum EXACTLY in f32 under any grouping, so
+    the N-level tree spine must be bit-for-bit the flat psum — the 3-level
+    generalization of the 2-level collective parity above."""
+    tree = TopologyTree.declared("pod:2,host:2", 8)
+    mesh = tree_mesh(jax.devices(), tree.names, tree.sizes)
+    names, sizes = tree.names, tree.sizes
+    n = len(jax.devices())
+    vals = np.random.RandomState(5).randint(-64, 64, size=(n, 133)).astype(
+        np.float32
+    )
+    x = jax.device_put(vals, NamedSharding(mesh, P(names)))
+    wires = ("fp32",) * len(names)
+
+    def tree_body(v):
+        out, res = wirefmt.tree_allreduce(
+            v[0], jax.random.PRNGKey(0), names, sizes, wires
+        )
+        # fp32 hops: residuals exist but stay exactly zero
+        for r in res:
+            assert r.dtype == jnp.float32
+        return out[None]
+
+    def flat_body(v):
+        return jax.lax.psum(v, names)
+
+    spec = P(names)
+    out_t = np.asarray(
+        jax.jit(
+            shard_map(tree_body, mesh=mesh, in_specs=spec, out_specs=spec,
+                      check_vma=False)
+        )(x)
+    )
+    out_f = np.asarray(
+        jax.jit(
+            shard_map(flat_body, mesh=mesh, in_specs=spec, out_specs=spec,
+                      check_vma=False)
+        )(x)
+    )
+    expect = vals.sum(axis=0)
+    np.testing.assert_array_equal(out_t[0], expect)
+    np.testing.assert_array_equal(out_t, out_f)
+
+
+def test_nlevel_run_and_residual_checkpoint_roundtrip(bundle, tmp_path):
+    """End-to-end 3-level run (pod:2,host:2,device:2 over 8 CPU devices)
+    with per-hop codecs int4/int8/fp32: trains finite, carries one
+    residual row-block per compressed hop, and the PER-HOP residual tuple
+    round-trips through orbax save/restore with sharding re-placement."""
+    ck = str(tmp_path / "ck_nlevel")
+    cfg = _cfg(
+        hier_levels="pod:2,host:2",
+        grad_comm_wires="int4,int8,fp32",
+        epoch_size=1,
+        ckpt_dir=ck,
+    )
+    tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+    rec = tr.run()
+    assert tr.grad_comm == "hier"
+    assert tr._topo_tree.levels == (("pod", 2), ("host", 2), ("device", 2))
+    assert tr.steps.grad_comm_wires == ("int4", "int8", "fp32")
+    assert np.isfinite(rec.data["train_loss"]).all()
+    res = tr.state.comm_residual
+    assert isinstance(res, tuple) and len(res) == 2  # hops 0..k-1
+    assert res[0].shape == (8, res[0].shape[1])
+    assert res[1].shape == (8, res[1].shape[1])
+    assert res[1].shape[1] == 2 * res[0].shape[1]  # widths shrink up-tree
+    # both compressed hops left real error
+    assert float(np.abs(np.asarray(res[0])).max()) > 0.0
+    assert float(np.abs(np.asarray(res[1])).max()) > 0.0
+    flush_checkpoints(ck)
+    saved = [np.asarray(r) for r in res]
+    tr2 = Trainer(cfg, bundle=bundle, log_to_file=False)
+    restored = restore_checkpoint(ck, tr2.state)
+    assert restored is not None
+    _epoch, state, _ctl = restored
+    for r, s in zip(state.comm_residual, saved):
+        np.testing.assert_array_equal(np.asarray(r), s)
+        assert r.sharding.spec == P(("pod", "host", "device"))
+    flush_checkpoints(close=True)
+
+
+def test_nlevel_elastic_reshard_restricts_tree(bundle):
+    """An elastic re-shard RESTRICTS the 3-level tree over the survivors:
+    8 -> 6 devices keeps (pod:2, device:3) — the outer level survives,
+    the inner levels collapse into the remainder — instead of the old
+    all-or-nothing flat fallback."""
+    from dynamic_load_balance_distributeddnn_tpu.faults import (
+        PreemptionEvent,
+        PreemptionInjector,
+    )
+
+    cfg = _cfg(
+        hier_levels="pod:2,host:2",
+        dynamic_batch_size=True,
+        grad_comm_wire="int8",
+        epoch_size=3,
+        elastic="on",
+    )
+    inj = PreemptionInjector(
+        8,
+        [
+            PreemptionEvent(worker=6, down_at=1.4, rejoin_epoch=None),
+            PreemptionEvent(worker=7, down_at=1.4, rejoin_epoch=None),
+        ],
+    )
+    tr = Trainer(cfg, bundle=bundle, injector=inj, log_to_file=False)
+    rec = tr.run()
+    assert tr.world_size == 6
+    assert tr.grad_comm == "hier"
+    assert tr._topo_tree.levels == (("pod", 2), ("device", 3))
+    assert np.isfinite(rec.data["train_loss"]).all()
 
 
 # ----------------------------------------------------------------- sentinel

@@ -78,6 +78,11 @@ def model_of(src: str, path: str = "m.py") -> MeshModel:
         # ordinary unknown-axis check, and an UNRELATED axis_names read in
         # the body must not silence an opaque return (review hardening)
         ("g014_attrprop_violation.py", "G014", 3),
+        # N-tuple collective axes (ISSUE 17): the tree combine's 3- and
+        # 4-member axis tuples resolve member-by-member — a typo'd middle
+        # member, a stale sub-tuple bind, and an undeclared-level
+        # axis_index all trip
+        ("g014_ntuple_violation.py", "G014", 3),
     ],
 )
 def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -102,6 +107,7 @@ def test_mesh_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings)
         "g014_tuplevar_clean.py",
         "g016_dictval_clean.py",
         "g014_attrprop_clean.py",
+        "g014_ntuple_clean.py",
     ],
 )
 def test_clean_fixture_is_quiet(fixture):

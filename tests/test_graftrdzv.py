@@ -127,7 +127,7 @@ def test_protocol_table_loads_from_rendezvous_source():
     proto = load_protocol()
     assert proto["version"] >= 1
     assert set(proto["files"]) == {
-        "ack", "propose", "torn", "loss", "join", "done",
+        "ack", "propose", "torn", "loss", "join", "done", "probe",
     }
     assert proto["phases"] == (
         "running", "agree", "teardown", "establish", "established",

@@ -327,7 +327,7 @@ def test_zero1_hier_fp32_matches_flat_end_to_end(bundle):
     assert trace.sharding.spec == P(("device", "host"))
     res = tr_h.state.comm_residual
     assert res is not None and float(np.abs(np.asarray(res)).max()) == 0.0
-    assert res.shape[1] * 4 == trace.shape[0]  # chunk_d = padded / D
+    assert res[0].shape[1] * 4 == trace.shape[0]  # chunk_d = padded / D
 
 
 def test_zero1_hier_int8_trains(bundle):

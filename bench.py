@@ -1022,6 +1022,54 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
                 except OSError:
                     pass
         _write_atomic(out_path, out)
+
+    if (
+        force_cpu
+        and os.environ.get("BENCH_MULTISTREAM_AB", "1") == "1"
+        and "multistream_ab" not in out["instr"]
+    ):
+        if resume.get("instr", {}).get("multistream_ab"):
+            out["instr"]["multistream_ab"] = resume["instr"]["multistream_ab"]
+        else:
+            # K-small-jobs sequential vs multiplexed A/B (ISSUE 18
+            # acceptance) in a dedicated subprocess: the legs want a fresh
+            # 8-device mesh and their own compile lineage.
+            fd, ab_path = tempfile.mkstemp(suffix=".json")
+            os.close(fd)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+            proc = None
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--multistream-ab", "--out", ab_path],
+                    capture_output=True,
+                    text=True,
+                    timeout=float(
+                        os.environ.get("BENCH_MULTISTREAM_AB_TIMEOUT", 900)
+                    ),
+                    env=env,
+                )
+                with open(ab_path) as f:
+                    ab = json.load(f)
+                if proc.returncode == 0 and "speedup_x" in ab:
+                    out["instr"]["multistream_ab"] = ab
+                else:
+                    sys.stderr.write(
+                        f"[bench] multistream_ab incomplete "
+                        f"(rc={proc.returncode}, keys={sorted(ab)}); dropped\n"
+                    )
+            except Exception as e:
+                sys.stderr.write(f"[bench] multistream_ab failed: {e}\n")
+            finally:
+                if proc is not None and proc.returncode != 0 and proc.stderr:
+                    sys.stderr.write(proc.stderr[-800:] + "\n")
+                try:
+                    os.unlink(ab_path)
+                except OSError:
+                    pass
+        _write_atomic(out_path, out)
     return 0
 
 
@@ -2040,6 +2088,139 @@ def run_zero1_ab(out_path: str) -> int:
     return 0
 
 
+def run_multistream_ab(out_path: str) -> int:
+    """K-small-jobs sequential vs multiplexed A/B (ISSUE 18 acceptance
+    field ``multistream_ab``), in a dedicated subprocess on an 8-device
+    CPU mesh.
+
+    Each job is a SMALL tenant by construction — a 2-worker world pinned
+    to its own device pair, the shape a training service actually receives
+    (a tiny job cannot feed the whole pool: past a few devices its
+    marginal product is ~0 in dispatch/collective overhead). Arm A
+    (sequential): the K jobs run one after another — the one-job-at-a-time
+    service shape, 6 of 8 devices idle at any moment. Arm B (multiplexed):
+    the SAME K JobSpecs submitted to one ``MultiStreamEngine``; the outer
+    solve packs all K onto the pool and they run concurrently on disjoint
+    device pairs. Total examples, epochs, and per-job compile lineage are
+    identical by construction (fresh trainer per job in both arms).
+
+    Reported: per-arm total wall, aggregate examples/s, ``speedup_x``
+    (sequential / multiplexed, acceptance >= 1.2), per-job makespans, and
+    the multiplexed arm's device-idle fraction."""
+    done = _install_init_watchdog()
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dynamic_load_balance_distributeddnn_tpu.config import Config
+    from dynamic_load_balance_distributeddnn_tpu.data.datasets import (
+        synthetic_dataset,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.runtime.scheduler import (
+        JobSpec,
+        MultiStreamEngine,
+    )
+    from dynamic_load_balance_distributeddnn_tpu.train import Trainer
+
+    n_jobs = int(os.environ.get("BENCH_MULTISTREAM_JOBS", 4))
+    n_epochs = int(os.environ.get("BENCH_MULTISTREAM_EPOCHS", 3))
+    n_train = int(os.environ.get("BENCH_MULTISTREAM_NTRAIN", 512))
+    pool = len(jax.devices())
+    per_job = max(pool // n_jobs, 1)
+    ab = {
+        "jobs": n_jobs,
+        "epochs_per_job": n_epochs,
+        "n_train": n_train,
+        "pool_devices": pool,
+        "devices_per_job": per_job,
+        "model": "mnistnet",
+    }
+    bundle = synthetic_dataset("mnist", n_train=n_train, n_test=256)
+    work_dir = tempfile.mkdtemp(prefix="multistream_ab_")
+
+    def job_cfg(i: int, arm: str) -> Config:
+        return Config(
+            debug=True,
+            world_size=per_job,
+            # the tenant's own device pair — the pool ordinals the outer
+            # solve hands job i at equal demand (keep-phase + sorted free
+            # draw), so admission rides the no-op allotment path in arm B
+            # and arm A runs the identical world shape
+            device=[per_job * i + d for d in range(per_job)],
+            batch_size=64,
+            learning_rate=0.05,
+            epoch_size=n_epochs,
+            dataset="mnist",
+            model="mnistnet",
+            dynamic_batch_size=False,
+            seed=100 + i,
+            bucket=8,
+            stat_dir=os.path.join(work_dir, f"{arm}_job{i}"),
+        )
+
+    done.set()
+
+    # ---- arm A: sequential, each job alone on the full pool ----
+    serial_walls = []
+    t0 = time.perf_counter()
+    for i in range(n_jobs):
+        t_job = time.perf_counter()
+        Trainer(job_cfg(i, "seq"), bundle=bundle, log_to_file=False).run()
+        serial_walls.append(round(time.perf_counter() - t_job, 3))
+    ab["sequential_wall_s"] = round(time.perf_counter() - t0, 3)
+    ab["sequential_job_walls_s"] = serial_walls
+    _write_atomic(out_path, ab)
+
+    # ---- arm B: the same jobs multiplexed over one pool ----
+    eng = MultiStreamEngine(n_devices=pool)
+    for i in range(n_jobs):
+        eng.submit(
+            JobSpec(
+                f"job{i}",
+                job_cfg(i, "ms"),
+                bundle=bundle,
+                max_devices=per_job,
+            )
+        )
+    t0 = time.perf_counter()
+    jobs = eng.run()
+    ab["multiplexed_wall_s"] = round(time.perf_counter() - t0, 3)
+    st = eng.stats()
+    ab["multiplexed_makespans_s"] = {
+        j: round(info["makespan_s"], 3) for j, info in st["jobs"].items()
+    }
+    ab["multiplexed_device_idle_fraction"] = (
+        round(st["device_idle_fraction"], 4)
+        if st["device_idle_fraction"] is not None
+        else None
+    )
+    ab["multiplexed_migrations"] = st["migrations"]
+    ab["all_jobs_done"] = all(
+        js.status == "done" for js in jobs.values()
+    )
+
+    examples = float(n_jobs * n_epochs * n_train)
+    ab["sequential_examples_per_s"] = round(
+        examples / max(ab["sequential_wall_s"], 1e-9), 1
+    )
+    ab["multiplexed_examples_per_s"] = round(
+        examples / max(ab["multiplexed_wall_s"], 1e-9), 1
+    )
+    ab["speedup_x"] = round(
+        ab["sequential_wall_s"] / max(ab["multiplexed_wall_s"], 1e-9), 3
+    )
+    ab["meets_1_2x"] = bool(ab["speedup_x"] >= 1.2)
+    ab["note"] = (
+        f"{n_jobs} small ({per_job}-worker) mnistnet jobs over one "
+        f"{pool}-device pool: the sequential arm runs them one at a time "
+        f"({pool - per_job} devices idle throughout); the engine packs "
+        f"all {n_jobs} concurrently on disjoint slices"
+    )
+    _write_atomic(out_path, ab)
+    return 0
+
+
 def _steady(walls_off, walls_on):
     """Steady-state epoch-wall windows. Off arm: skip epoch 0 (calibration,
     no injection). On arm: skip epoch 0 AND epoch 1 — epoch 1 is injected but
@@ -2510,6 +2691,8 @@ def main() -> int:
         return run_grad_comm_ab(sys.argv[sys.argv.index("--out") + 1])
     if "--zero1-ab" in sys.argv:
         return run_zero1_ab(sys.argv[sys.argv.index("--out") + 1])
+    if "--multistream-ab" in sys.argv:
+        return run_multistream_ab(sys.argv[sys.argv.index("--out") + 1])
     if "--grad-comm-worker" in sys.argv:
         i = sys.argv.index("--grad-comm-worker")
         return run_grad_comm_worker(

@@ -285,13 +285,19 @@ class Config:
                                        # compress_grads (quantized
                                        # reduce-scatter) and grad_comm=hier
                                        # (the in-host RS + compressed DCN
-                                       # hop). Excluded: scan-mode
-                                       # supersteps and packed epochs fall
-                                       # back to windowed dispatch, and
-                                       # non-elementwise transforms (global-
-                                       # norm clipping INSIDE tx) are out of
-                                       # contract — the per-worker grad_clip
-                                       # runs before the combine and is fine.
+                                       # hop), and since PR 18 scan-mode
+                                       # supersteps and packed epochs (the
+                                       # axis-free zero-1 twin runs inside
+                                       # the compiled window). Excluded:
+                                       # shard_update x compress_grads keeps
+                                       # the windowed cadence in scan
+                                       # topologies (stochastic rounding is
+                                       # no identity even on a size-1 axis),
+                                       # and non-elementwise transforms
+                                       # (global-norm clipping INSIDE tx)
+                                       # are out of contract — the per-worker
+                                       # grad_clip runs before the combine
+                                       # and is fine.
     stream_chunk_steps: int = 128      # host data path streams the epoch in
                                        # windows of this many steps (gather +
                                        # device_put of window k+1 overlaps

@@ -53,6 +53,7 @@ from typing import Dict, List, Optional
 
 from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
     attribution,
+    attribution_by_job,
     load_trace,
     merge_trace_events,
     merge_trace_files,
@@ -113,6 +114,42 @@ def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
 
     out = [line(header), line(["-" * w for w in widths])]
     out += [line(r) for r in rows]
+    return "\n".join(out)
+
+
+def summarize_by_job(
+    path: str, as_json: bool = False, with_workers: bool = True
+) -> str:
+    """Per-TENANT wall attribution for many-stream traces: one row per job
+    tag (``Tracer.set_job``), with epoch count, total epoch wall, and the
+    dominant phases. Single-job traces render under the ``-`` pseudo-job."""
+    events, workers, skipped = _load_stitched(path, with_workers)
+    att = attribution_by_job(events)
+    if as_json:
+        payload = dict(att)
+        if skipped:
+            payload["skipped_traces"] = skipped
+        return json.dumps(payload)
+    jobs = att["jobs"]
+    if not jobs:
+        return "no epoch spans recorded (run with --trace on|ring)"
+    rows = []
+    for job, info in jobs.items():
+        top = sorted(info["phases"].items(), key=lambda kv: -kv[1])[:3]
+        rows.append(
+            [
+                job,
+                str(info["epochs"]),
+                f"{info['wall_s']:.4f}",
+                ", ".join(f"{n} {s:.3f}s" for n, s in top) or "-",
+            ]
+        )
+    out = [_fmt_table(rows, ["job", "epochs", "wall (s)", "top phases"])]
+    if skipped:
+        out.append(
+            f"skipped {len(skipped)} unreadable worker trace file(s): "
+            + ", ".join(skipped)
+        )
     return "\n".join(out)
 
 
@@ -689,6 +726,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--json", action="store_true")
     s.add_argument("--no-workers", action="store_true",
                    help="do not stitch sibling compile_worker_*.trace.json")
+    s.add_argument("--by-job", action="store_true",
+                   help="attribute wall per tenant (many-stream traces: one "
+                   "row per job tag instead of per epoch index)")
     d = sub.add_parser("diff", help="phase-total deltas between two traces")
     d.add_argument("trace_a")
     d.add_argument("trace_b")
@@ -736,14 +776,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     try:
         if args.cmd == "summarize":
-            print(
-                summarize(
-                    args.trace,
-                    epoch=args.epoch,
-                    as_json=args.json,
-                    with_workers=not args.no_workers,
+            if args.by_job:
+                if args.epoch is not None:
+                    raise ValueError("--by-job and --epoch are exclusive")
+                print(
+                    summarize_by_job(
+                        args.trace,
+                        as_json=args.json,
+                        with_workers=not args.no_workers,
+                    )
                 )
-            )
+            else:
+                print(
+                    summarize(
+                        args.trace,
+                        epoch=args.epoch,
+                        as_json=args.json,
+                        with_workers=not args.no_workers,
+                    )
+                )
         elif args.cmd == "merge":
             workers = _worker_traces(args.trace)
             out = merge_trace_files(args.trace, workers, out_path=args.out)

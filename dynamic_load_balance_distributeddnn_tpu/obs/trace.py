@@ -168,6 +168,16 @@ class Tracer:
         # of these unix stamps
         self._base_unix = time.time()
         self._current_epoch: Optional[int] = None
+        # Per-job tagging (many-stream engine): a thread that calls
+        # set_job() gets THREAD-LOCAL job + epoch state, so concurrent job
+        # threads stamp their own spans without stomping the global epoch
+        # the single-job engine uses. Threads that never set a job tag see
+        # the same behavior as before job tags existed (global epoch, no
+        # job key). Deliberately unlocked: threading.local() stores every
+        # thread's tags in per-thread slots — the "cross-thread" writes
+        # never touch shared state — and this rebind happens only at run
+        # boundaries (same contract as `enabled` above).
+        self._tls = threading.local()  # graftlint: disable=G012
         self._thread_names: Dict[int, str] = {}
         return self
 
@@ -207,8 +217,24 @@ class Tracer:
     def set_epoch(self, epoch: Optional[int]) -> None:
         """Stamp subsequent events with this epoch index (attribution key).
         The engine sets it at each epoch boundary; None = outside any epoch
-        (warm-up, teardown)."""
-        self._current_epoch = epoch
+        (warm-up, teardown). On a thread carrying a job tag (:meth:`set_job`)
+        the epoch is stored thread-locally — concurrent jobs each run their
+        own epoch counter without racing on the global."""
+        if getattr(self._tls, "job", None) is not None:
+            self._tls.epoch = epoch
+        else:
+            self._current_epoch = epoch
+
+    def set_job(self, job: Optional[str]) -> None:
+        """Tag THIS THREAD's subsequently emitted events with a job id
+        (many-stream engine: one thread drives one job's epochs). The tag
+        and the epoch index both become thread-local for the calling
+        thread, so `graftscope summarize --by-job` can attribute wall per
+        tenant; ``None`` clears the tag (the thread rejoins the global
+        epoch stream)."""
+        self._tls.job = job
+        if job is None:
+            self._tls.epoch = None
 
     # -------------------------------------------------------------- emitters
 
@@ -256,10 +282,17 @@ class Tracer:
         if tid not in self._thread_names:
             # dict writes are GIL-atomic; a benign race re-writes the same name
             self._thread_names[tid] = threading.current_thread().name
-        epoch = self._current_epoch
-        if epoch is not None:
+        job = getattr(self._tls, "job", None)
+        if job is not None:
+            epoch = getattr(self._tls, "epoch", None)
+        else:
+            epoch = self._current_epoch
+        if epoch is not None or job is not None:
             args = dict(args) if args else {}
-            args.setdefault("epoch", epoch)
+            if epoch is not None:
+                args.setdefault("epoch", epoch)
+            if job is not None:
+                args.setdefault("job", job)
         rec = (
             name,
             cat,
@@ -531,6 +564,50 @@ def attribution(events: List[dict]) -> Dict:
         "epochs": epochs,
         "phase_totals_s": {k: round(v, 6) for k, v in sorted(totals.items())},
         "coverage_min": round(coverage_min, 4) if coverage_min is not None else None,
+    }
+
+
+def attribution_by_job(events: List[dict]) -> Dict:
+    """Per-JOB wall attribution (many-stream engine): epoch spans carrying
+    an ``args.job`` tag (set by :meth:`Tracer.set_job` on each job's driver
+    thread) group per tenant instead of per epoch index. Returns::
+
+        {"jobs": {job: {"wall_s", "epochs", "phases": {name: s}}}}
+
+    Untagged spans (a single-job run) land under the ``"-"`` pseudo-job,
+    so `graftscope summarize --by-job` degrades gracefully on legacy
+    traces."""
+    jobs: Dict[str, Dict] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        if args.get("epoch") is None and ev.get("cat") not in (
+            EPOCH_CAT, PHASE_CAT,
+        ):
+            continue
+        job = str(args.get("job", "-"))
+        dur_s = float(ev.get("dur", 0.0)) / 1e6
+        rec = jobs.setdefault(
+            job, {"wall_s": 0.0, "epochs": set(), "phases": {}}
+        )
+        if ev.get("cat") == EPOCH_CAT:
+            rec["wall_s"] += dur_s
+            if args.get("epoch") is not None:
+                rec["epochs"].add(args["epoch"])
+        elif ev.get("cat") == PHASE_CAT:
+            rec["phases"][ev["name"]] = rec["phases"].get(ev["name"], 0.0) + dur_s
+    return {
+        "jobs": {
+            job: {
+                "wall_s": round(rec["wall_s"], 6),
+                "epochs": len(rec["epochs"]),
+                "phases": {
+                    k: round(v, 6) for k, v in sorted(rec["phases"].items())
+                },
+            }
+            for job, rec in sorted(jobs.items())
+        }
     }
 
 

@@ -184,6 +184,12 @@ PROTOCOL = {
             "writers": ("RendezvousStateMachine.publish_probe",),
             "tolerate": "missing-or-torn",
         },
+        "rebuild": {
+            "pattern": "rebuild_g{gen}_a{attempt}_p{ident}.json",
+            "format": "json",
+            "writers": ("RendezvousStateMachine.rebuild_vote",),
+            "tolerate": "missing-or-torn",
+        },
         "done": {
             "pattern": "done_p{ident}",
             "format": "marker",
@@ -211,6 +217,7 @@ PROTOCOL = {
         "rdzv_timeout": "*",
         "rdzv_drain_timeout": "teardown",
         "rdzv_quarantine_rebuild": "establish",
+        "rdzv_rebuild_vote": "establish",
     },
     # engine recovery spine: callee tail -> phase index. G018 checks that
     # recovery paths never call a lower phase after a higher one
@@ -556,10 +563,14 @@ def quarantine_runtime(logger=None, tick: Callable = heartbeat) -> int:
     and a :class:`RendezvousError` (-> abort-and-resume) when the runtime
     never settles.
 
-    Recorded limitation: with MULTIPLE surviving processes a canary-driven
-    rebuild re-runs the CPU topology exchange against the generation's KV
-    store; survivors disagree-ing on their rebuild count is not handled
-    (the CPU-tier shrink target is a single surviving process)."""
+    With MULTIPLE surviving processes a canary-driven rebuild re-runs the
+    CPU topology exchange against the generation's KV store, so survivors
+    must not diverge on their rebuild count. The engine's rebuild-retry
+    loop keeps them in lockstep by voting each attempt through
+    :meth:`RendezvousStateMachine.rebuild_vote` /
+    :meth:`RendezvousStateMachine.rebuild_settled`: a round stands only
+    when every survivor's rebuild succeeded, and any failure sends ALL of
+    them back around together."""
     gs = _global_state()
     attempts = 4 if gs.num_processes in (None, 1) else 2
     last: Optional[Exception] = None
@@ -782,6 +793,67 @@ class RendezvousStateMachine:
                 last_tick = now
                 self.tick()
             time.sleep(_POLL_S)
+
+    # --------------------------------------------------- rebuild coherence
+
+    def rebuild_vote(self, attempt: int, ok: bool) -> None:
+        """Publish this survivor's verdict on rebuild round ``attempt`` of
+        the CURRENT generation (ISSUE 18: the multi-survivor lift of the
+        rebuild retry loop). The engine's post-establish world rebuild —
+        quarantine canary, re-shard, state re-placement — retries locally
+        when the new backend inherited the dead world's dispatch chain;
+        with several survivors those retry counts used to be process-local,
+        so one survivor could advance to the next attempt's collectives
+        while a peer was still tearing its backend down. Votes make the
+        round a unit: every survivor publishes ok/failed, and the round
+        only stands when ALL of them succeeded."""
+        _write_json(
+            os.path.join(
+                self.rdzv_dir,
+                f"rebuild_g{self.gen}_a{int(attempt)}_p{self.ident}.json",
+            ),
+            {"ident": self.ident, "ok": bool(ok)},
+        )
+        get_tracer().instant(
+            "rdzv_rebuild_vote", cat="rdzv",
+            args={"gen": self.gen, "attempt": int(attempt), "ok": bool(ok)},
+        )
+
+    def rebuild_settled(
+        self,
+        procs: Iterable[int],
+        attempt: int,
+        timeout_s: Optional[float] = None,
+    ) -> bool:
+        """Collect every listed survivor's vote for rebuild round
+        ``attempt``: True only when ALL voted ok (the callers may adopt the
+        rebuilt world), False when any voted failed (every caller — the
+        locally-successful ones included — tears down and retries the next
+        round in lockstep). A survivor whose vote never lands within
+        ``DBS_RDZV_REBUILD_S`` raises :class:`RendezvousTimeout` — it died
+        or wedged mid-rebuild, and waiting longer just hides a second
+        failure inside the first recovery."""
+        if timeout_s is None:
+            timeout_s = _env_timeout("DBS_RDZV_REBUILD_S", 60.0)
+        want = sorted(int(p) for p in procs)
+        votes: Dict[int, bool] = {}
+
+        def _collected() -> bool:
+            for p in want:
+                if p in votes:
+                    continue
+                info = _read_json(
+                    os.path.join(
+                        self.rdzv_dir,
+                        f"rebuild_g{self.gen}_a{int(attempt)}_p{p}.json",
+                    )
+                )
+                if info is not None:
+                    votes[p] = bool(info.get("ok"))
+            return len(votes) == len(want)
+
+        self._wait(_collected, timeout_s, f"rebuild-vote[{int(attempt)}]")
+        return all(votes.values())
 
     # ----------------------------------------------------------- consensus
 

@@ -119,25 +119,43 @@ class Trainer:
         logger=None,
         log_to_file: bool = True,
         timing_model=None,
+        job_id: Optional[str] = None,
     ):
         """``timing_model``: optional callable(plan) -> per-worker seconds,
         replacing wall-clock probes with a deterministic model — used by tests
         to verify the controller dynamics hermetically (wall-clock on tiny CPU
-        batches is dispatch-overhead-dominated and not ∝ batch size)."""
+        batches is dispatch-overhead-dominated and not ∝ batch size).
+
+        ``job_id``: tenant tag when this trainer is one stream of a
+        :class:`~..runtime.scheduler.MultiStreamEngine` pool. Folded into
+        ``_comm_sig`` so every AOT-registry key carries the tenant — two
+        jobs with identical model/topology must never resolve each other's
+        executables through any shared compile cache or artifact."""
         self.cfg = cfg
         self.timing_model = timing_model
+        self.job_id = job_id
         self.logger = logger or init_logger(cfg, to_file=log_to_file)
 
         # graftscope tracer, configured FIRST (see the fuller note at the
         # MetricsRegistry construction below): instrumentation that runs
         # during init itself — the hier combine's link-bandwidth probe and
         # its comm_* phase spans — must land in THIS run's trace, not the
-        # previous configuration's buffer (or the void)
-        self._trace = get_tracer().configure(
-            cfg.trace,
-            ring_size=cfg.trace_ring,
-            jax_annotations=cfg.trace_annotations,
-        )
+        # previous configuration's buffer (or the void). A TENANT trainer
+        # (job_id set — one stream of a MultiStreamEngine) must NOT
+        # reconfigure the process-wide tracer: configure() rebuilds the
+        # event buffer and the thread-local job-tag slots, so a second
+        # tenant's admission would drop every earlier tenant's spans and
+        # untag their worker threads. In many-stream mode the engine's
+        # caller owns the tracer config; per-tenant trace flags are
+        # ignored.
+        if job_id is None:
+            self._trace = get_tracer().configure(
+                cfg.trace,
+                ring_size=cfg.trace_ring,
+                jax_annotations=cfg.trace_annotations,
+            )
+        else:
+            self._trace = get_tracer()
 
         # Multi-host: each process owns a contiguous slice of the global
         # workers, mapped onto its LOCAL devices; the combine mesh spans every
@@ -2427,16 +2445,22 @@ class Trainer:
         other. The hier signature is the full tree with each hop's wire:
         one (name, size, wire) triple per level, outermost first."""
         return (
-            ("hier",)
-            + tuple(
-                (name, size, wire)
-                for (name, size), wire in zip(
-                    self._topo_tree.levels, self._grad_comm_wires
+            (
+                ("hier",)
+                + tuple(
+                    (name, size, wire)
+                    for (name, size), wire in zip(
+                        self._topo_tree.levels, self._grad_comm_wires
+                    )
                 )
+                if self.grad_comm == "hier"
+                else ("flat",)
             )
-            if self.grad_comm == "hier"
-            else ("flat",)
-        ) + (("zero1",) if self.cfg.shard_update else ())
+            + (("zero1",) if self.cfg.shard_update else ())
+            # many-stream tenancy: the job id namespaces every comm-sig-keyed
+            # executable per tenant (the _aot_gen component stays per-trainer)
+            + ((("job", self.job_id),) if self.job_id is not None else ())
+        )
 
     def _quiesce_pipeline(self) -> None:
         """Drain the concurrent readers of the topology fields before a
@@ -3026,10 +3050,12 @@ class Trainer:
             # catches an inheritance that already landed, the final
             # block_until_ready catches one that landed mid-rebuild, and a
             # poisoned attempt tears the backend down and rebuilds from
-            # scratch (cheap: ~0.3s on the CPU tier). Recorded limitation:
-            # retry counts are process-local, so divergence across MULTIPLE
-            # survivors is not handled (the CPU-tier shrink target is a
-            # single surviving process; see quarantine_runtime).
+            # scratch (cheap: ~0.3s on the CPU tier). With MULTIPLE
+            # survivors each attempt is a voted round (ISSUE 18:
+            # rdzv.rebuild_vote / rebuild_settled): every survivor
+            # publishes its verdict and the round only stands when all
+            # succeeded — retry counts can no longer diverge across
+            # processes, so attempt N's collectives always pair N-to-N.
             self.n_proc = len(roster)
             self.proc_id = agreement.rank
             self._proc_roster = roster
@@ -3049,6 +3075,9 @@ class Trainer:
             restored_from = "epoch snapshot"
             ctl = None
             rebuild_err: Optional[Exception] = None
+            # the rebuild-vote electorate: survivors only — joiners enter
+            # through join_elastic_world after the survivor world settles
+            survivors = [p for p in roster if p not in set(joining)]
             for attempt in range(5):
                 try:
                     rdzv.quarantine_runtime(logger=self.logger, tick=heartbeat)
@@ -3107,7 +3136,6 @@ class Trainer:
                     # inside the retry scope, not an epoch later
                     materialize(self.state)
                     rebuild_err = None
-                    break
                 except Exception as e:  # noqa: BLE001 — poisoned-world rebuild
                     rebuild_err = e
                     self.state = None
@@ -3126,6 +3154,46 @@ class Trainer:
                     # long enough to land past that instead of burning
                     # attempts inside the window
                     time.sleep(1.0 * (attempt + 1))
+                # Multi-survivor rebuild coherence: each attempt is a voted
+                # round — it stands only when EVERY survivor's rebuild
+                # succeeded. Otherwise all of them (the locally-successful
+                # ones included) tear down and retry together, so attempt
+                # N's collectives always pair N-to-N instead of a fast
+                # survivor's attempt-1 ops meeting a slow peer's attempt-2.
+                # Joiners don't vote: they enter via join_elastic_world
+                # only after the survivor world settles.
+                if len(survivors) > 1:
+                    round_ok = False
+                    try:
+                        self._rdzv.rebuild_vote(
+                            attempt, ok=rebuild_err is None
+                        )
+                        round_ok = self._rdzv.rebuild_settled(
+                            survivors, attempt
+                        )
+                    except rdzv.RendezvousError as e:
+                        # a peer that exhausted its attempts aborts without
+                        # voting — its silence times this wait out, and the
+                        # remaining survivors abort coherently with it
+                        self._mh_rdzv_failed(e, epoch)
+                    if not round_ok and rebuild_err is None:
+                        rebuild_err = rdzv.RendezvousError(
+                            "world rebuild",
+                            f"attempt {attempt + 1} voted down by a peer",
+                        )
+                        self.state = None
+                        self._cache_repl = None
+                        self._cache_dev = {}
+                        self.logger.warning(
+                            f"elastic: rebuild attempt {attempt + 1} "
+                            "succeeded locally but a peer voted it down — "
+                            "rebuilding in lockstep"
+                        )
+                        heartbeat()
+                        rdzv.reset_backend()
+                        time.sleep(1.0 * (attempt + 1))
+                if rebuild_err is None:
+                    break
             if rebuild_err is not None:
                 self._mh_rdzv_failed(
                     rdzv.RendezvousError(
@@ -4174,7 +4242,6 @@ class Trainer:
             and self.bundle is not None
             and getattr(self.bundle, "train_x", None) is not None
             and cfg.grad_clip == 0
-            and not cfg.shard_update
             and not cfg.compress_grads
             and cfg.grad_accum <= 1
         )
@@ -4194,7 +4261,7 @@ class Trainer:
                 )
             raise ValueError(
                 "packed=on needs a single-device vision topology and no "
-                "grad_clip/shard_update/compress_grads/grad_accum"
+                "grad_clip/compress_grads/grad_accum"
             )
         return ok and fits
 
@@ -4594,17 +4661,20 @@ class Trainer:
         ``"step"`` — the legacy per-step loop (superstep="off"), kept as the
         bitwise-parity and dispatch-overhead reference.
 
-        shard_update excludes scan mode: the superstep body applies the
-        tree-level replicated update inside its scan, which the flat-chunk
-        sharded opt state cannot feed — those topologies run windowed (the
-        per-step zero-1 combine twin is an identity-collective on the
-        single-device mesh, so the math is unchanged)."""
+        shard_update composes with scan mode (the PR-13 fallback, closed):
+        the superstep body routes into the axis-free zero-1 twin
+        (``_zero1_update(..., with_comm=False, local_index=0)``), bitwise-
+        identical to the windowed combine twin's identity collectives on
+        the single-device mesh. The one remaining exclusion is
+        shard_update x compress_grads — the quantized reduce-scatter is
+        NOT an identity even over a size-1 axis (stochastic rounding), so
+        that pair keeps the windowed per-step combine cadence."""
         if self.cfg.superstep == "off":
             return "step"
         if (
             self.topology.single_group
             and self.n_proc == 1
-            and not self.cfg.shard_update
+            and not (self.cfg.shard_update and self.cfg.compress_grads)
         ):
             return "scan"
         return "window"

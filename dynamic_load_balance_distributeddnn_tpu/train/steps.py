@@ -459,11 +459,28 @@ class StepLibrary:
                 acc = jax.tree_util.tree_map(lambda a, t: a + t[None], acc, g)
             aux.append(jnp.stack([wloss, loss_sum, count, probe]))
         grads = jax.tree_util.tree_map(lambda t: jnp.sum(t, axis=0), acc)
-        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        state = state.replace(
-            params=params, opt_state=opt_state, step=state.step + 1
-        )
+        if self.shard_update:
+            # ZeRO-1 inside the scan (the shard_update x scan-mode gap,
+            # carried since PR 13): scan mode only exists on a 1-device
+            # mesh, where the windowed zero-1 combine twin's collectives
+            # are identities — with_comm=False with local_index=0 replays
+            # the exact same chunk math (chunk == padded, off == 0) with
+            # no collective-axis context needed, and the rng recipe
+            # matches _sharded_combine_body's at axis index 0.
+            rng = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(0x5D1E), 0), state.step
+            )
+            state = self._zero1_update(
+                state, grads, rng, with_comm=False, local_index=0
+            )
+        else:
+            updates, opt_state = self.tx.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            state = state.replace(
+                params=params, opt_state=opt_state, step=state.step + 1
+            )
         return state, jnp.stack(aux)
 
     @functools.cached_property
@@ -474,7 +491,6 @@ class StepLibrary:
         wkeys table the per-step path consumes, so the rng stream is
         identical. Returns (state, aux[win, n_workers, 4])."""
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
         def superstep(state, xs, ys, ws_, ks, slows):
             def body(st, inp):
                 return self._superstep_body(st, *inp, slows)
@@ -487,7 +503,8 @@ class StepLibrary:
             # bounds the unroll length via config.superstep_window.
             return jax.lax.scan(body, state, (xs, ys, ws_, ks), unroll=True)
 
-        return superstep
+        # donation rides the shard_update sanction (see _state_donate)
+        return jax.jit(superstep, donate_argnums=self._state_donate)
 
     @functools.cached_property
     def group_superstep_idx(self):
@@ -496,7 +513,6 @@ class StepLibrary:
         rows by index on device — the host ships [win, b_pad] int32 per
         worker instead of the batches."""
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
         def superstep(state, train_x, train_y, idxs, ws_, ks, slows):
             def body(st, inp):
                 iw, ws_s, ks_s = inp
@@ -512,7 +528,8 @@ class StepLibrary:
             # unrolled lowering
             return jax.lax.scan(body, state, (idxs, ws_, ks), unroll=True)
 
-        return superstep
+        # donation rides the shard_update sanction (see _state_donate)
+        return jax.jit(superstep, donate_argnums=self._state_donate)
 
     def superstep_cache_size(self) -> int:
         """Compiled (shape-tuple, window-length) superstep variants — the
@@ -893,7 +910,9 @@ class StepLibrary:
             out.append(total.astype(g.dtype))
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    def _zero1_update(self, state, local_grads, rng, with_comm: bool):
+    def _zero1_update(
+        self, state, local_grads, rng, with_comm: bool, local_index=None
+    ):
         """Generic sharded optimizer update (ZeRO-1 analogue, arXiv
         2004.13336) over an ARBITRARY optax transform: ravel the gradient
         tree ONCE, reduce-scatter into this device's 1/n chunk, run
@@ -916,7 +935,12 @@ class StepLibrary:
         ``compress_grads='int8'`` rides the quantized reduce-scatter
         (parallel/wire.py compressed_reduce_scatter). ``with_comm=False``
         builds the comm-free probe twin: same FLOPs shape, collectives
-        replaced by local slices/pads (output is discarded)."""
+        replaced by local slices/pads (output is discarded) — and, with
+        ``local_index`` given, the AXIS-FREE twin the scan-mode superstep
+        runs under plain jit (no shard_map axis context): the caller
+        supplies the flat chunk index instead of ``_data_axis_index()``.
+        On the 1-device mesh that path exists on, chunk == padded and the
+        slice/pad pair is the identity the size-1 collectives would be."""
         import jax.flatten_util
 
         opt = state.opt_state
@@ -986,7 +1010,24 @@ class StepLibrary:
             else:
                 g_chunk = jax.lax.dynamic_slice(flat_g, (off,), (chunk,))
         else:
-            off = self._data_axis_index() * chunk
+            # A size-1 data axis makes the uncompressed collectives
+            # identities — route the slice twin instead, so single-device
+            # topologies compile the SAME flat-update program on every
+            # dispatch path (per-step combine twin, fused shard body,
+            # scan-mode superstep). The scan x zero1 bitwise-parity
+            # contract rides on the lowering being shared, not merely
+            # value-equal: XLA contracts the update chain differently
+            # around a collective than around a slice (ulp-scale drift no
+            # optimization_barrier placement removes). The quantized wire
+            # stays collective — stochastic rounding is no identity even
+            # over one device.
+            if n == 1 and self.compress_grads != "int8":
+                with_comm = False
+                if local_index is None:
+                    local_index = 0
+            off = (
+                self._data_axis_index() if local_index is None else local_index
+            ) * chunk
             if with_comm:
                 if self.compress_grads == "int8":
                     g_chunk = wirefmt.compressed_reduce_scatter(

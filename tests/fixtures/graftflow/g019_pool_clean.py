@@ -1,0 +1,45 @@
+"""Clean twin of g019_pool_violation.py: the same re-partition, but the
+allocator drains its request-staging thread first (``_quiesce_allocator``
+joins it, bounded) and rebinds the map under the lock — the window-boundary
+discipline the in-tree ``DevicePool`` enforces with ``_quiesce_pool``.
+G019 accepts a preceding ``*quiesce*``/``*drain*`` call, a lock held at the
+write, or a lock held by every caller; this twin satisfies the first two.
+"""
+
+import threading
+
+
+def empty_mesh(n):
+    return {d: None for d in range(n)}
+
+
+class Pool:
+    def __init__(self, n):
+        self._lock = threading.Lock()
+        self._requests = []
+        self._stopped = False
+        self._mesh = empty_mesh(n)
+        self._server = threading.Thread(target=self._serve, daemon=True)
+        self._server.start()
+
+    def _serve(self):
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                if self._requests:
+                    self._requests.pop()
+
+    def request(self, job):
+        with self._lock:
+            self._requests.append(job)
+
+    def _quiesce_allocator(self):
+        with self._lock:
+            self._stopped = True
+        self._server.join(timeout=5.0)
+
+    def reallocate(self, n):
+        self._quiesce_allocator()
+        with self._lock:
+            self._mesh = empty_mesh(n)

@@ -1,0 +1,36 @@
+"""Seeded G019 violation (pool-allocator shape, ISSUE 18): the device-pool
+allocator re-partitions its ordinal→tenant map while the request-staging
+thread it spawned at construction is still live — no lock around the
+topology write, no quiesce step before it. A tenant staged against the old
+partition keeps dispatching onto ordinals that now belong to someone else.
+(The in-tree ``DevicePool`` gates every ``_mesh`` write on
+``_quiesce_pool()`` — topology writes are legal only between windows.)
+"""
+
+import threading
+
+
+def empty_mesh(n):
+    return {d: None for d in range(n)}
+
+
+class Pool:
+    def __init__(self, n):
+        self._lock = threading.Lock()
+        self._requests = []
+        self._mesh = empty_mesh(n)
+        self._server = threading.Thread(target=self._serve, daemon=True)
+        self._server.start()
+
+    def _serve(self):
+        while True:
+            with self._lock:
+                if self._requests:
+                    self._requests.pop()
+
+    def request(self, job):
+        with self._lock:
+            self._requests.append(job)
+
+    def reallocate(self, n):
+        self._mesh = empty_mesh(n)  # staging thread still running

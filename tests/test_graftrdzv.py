@@ -37,6 +37,10 @@ from dynamic_load_balance_distributeddnn_tpu.obs.scope_cli import (
     main as scope_main,
 )
 from dynamic_load_balance_distributeddnn_tpu.obs.spool import SpoolWriter
+from dynamic_load_balance_distributeddnn_tpu.runtime.rendezvous import (
+    RendezvousStateMachine,
+    RendezvousTimeout,
+)
 
 FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "graftflow"
 REPO = pathlib.Path(__file__).resolve().parents[1]
@@ -65,6 +69,9 @@ def repo_project():
         ("g018_violation.py", "G018", 1),
         # unlocked mesh rebuild with a live staging thread, no quiesce
         ("g019_violation.py", "G019", 1),
+        # ISSUE 18: pool allocator re-partitions ordinal→tenant map with a
+        # live staging thread — no lock, no window quiesce
+        ("g019_pool_violation.py", "G019", 1),
     ],
 )
 def test_rdzv_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings):
@@ -83,6 +90,7 @@ def test_rdzv_rule_trips_on_seeded_fixture(fixture, expected_code, min_findings)
         "g017_clean.py",
         "g018_clean.py",
         "g019_clean.py",
+        "g019_pool_clean.py",
     ],
 )
 def test_rdzv_clean_fixture_is_quiet(fixture):
@@ -127,7 +135,7 @@ def test_protocol_table_loads_from_rendezvous_source():
     proto = load_protocol()
     assert proto["version"] >= 1
     assert set(proto["files"]) == {
-        "ack", "propose", "torn", "loss", "join", "done", "probe",
+        "ack", "propose", "torn", "loss", "join", "done", "probe", "rebuild",
     }
     assert proto["phases"] == (
         "running", "agree", "teardown", "establish", "established",
@@ -236,6 +244,43 @@ def test_mutation_catalogue_is_exercised():
 def test_unknown_mutation_is_rejected():
     with pytest.raises(ValueError, match="unknown mutation"):
         run_model_check(2, mutation="nonsense")
+
+
+# ------------------------------------------------- multi-survivor rebuild vote
+
+
+def test_rebuild_vote_settles_when_every_survivor_succeeds(tmp_path):
+    """ISSUE 18 satellite: both survivors publish ok on the same attempt ->
+    the round stands for BOTH of them (reading each other's files)."""
+    a = RendezvousStateMachine(str(tmp_path), ident=0, gen=3)
+    b = RendezvousStateMachine(str(tmp_path), ident=1, gen=3)
+    a.rebuild_vote(0, ok=True)
+    b.rebuild_vote(0, ok=True)
+    assert a.rebuild_settled([0, 1], 0, timeout_s=5.0) is True
+    assert b.rebuild_settled([0, 1], 0, timeout_s=5.0) is True
+
+
+def test_rebuild_vote_any_failure_fails_the_round_for_everyone(tmp_path):
+    a = RendezvousStateMachine(str(tmp_path), ident=0, gen=3)
+    b = RendezvousStateMachine(str(tmp_path), ident=1, gen=3)
+    a.rebuild_vote(1, ok=True)
+    b.rebuild_vote(1, ok=False)
+    # the locally-successful survivor learns its peer failed -> retries too
+    assert a.rebuild_settled([0, 1], 1, timeout_s=5.0) is False
+    assert b.rebuild_settled([0, 1], 1, timeout_s=5.0) is False
+    # attempts are independent rounds: round 1's verdict does not leak
+    a.rebuild_vote(2, ok=True)
+    b.rebuild_vote(2, ok=True)
+    assert a.rebuild_settled([0, 1], 2, timeout_s=5.0) is True
+
+
+def test_rebuild_vote_missing_peer_times_out(tmp_path):
+    """A survivor that aborted without voting must not hang its peers
+    forever: the wait degrades into a RendezvousTimeout -> abort-and-resume."""
+    a = RendezvousStateMachine(str(tmp_path), ident=0, gen=3)
+    a.rebuild_vote(0, ok=True)
+    with pytest.raises(RendezvousTimeout, match="rebuild-vote"):
+        a.rebuild_settled([0, 1], 0, timeout_s=0.3)
 
 
 # ------------------------------------------------------- shipped-tree hygiene

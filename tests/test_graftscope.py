@@ -12,6 +12,7 @@ from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
     EPOCH_CAT,
     Tracer,
     attribution,
+    attribution_by_job,
     load_trace,
 )
 from dynamic_load_balance_distributeddnn_tpu.obs.scope_cli import main as scope_main
@@ -179,6 +180,61 @@ def test_attribution_and_coverage(tmp_path):
     assert att["coverage_min"] is not None
 
 
+def test_attribution_by_job_groups_tenant_spans():
+    """Many-stream engine (ISSUE 18): epoch spans carrying the job tag set
+    by ``Tracer.set_job`` on each tenant's driver thread group per tenant;
+    untagged legacy spans degrade to the ``-`` pseudo-job."""
+    tr = Tracer(mode="on")
+    for job, n_epochs in (("alpha", 2), ("beta", 1)):
+        tr.set_job(job)
+        for epoch in range(n_epochs):
+            tr.set_epoch(epoch)
+            with tr.span("epoch", cat=EPOCH_CAT):
+                with tr.span("train"):
+                    pass
+        tr.set_epoch(None)
+    tr.set_job(None)
+    tr.set_epoch(0)
+    with tr.span("epoch", cat=EPOCH_CAT):  # untagged single-job shape
+        pass
+    tr.set_epoch(None)
+    att = attribution_by_job(tr.chrome_events())
+    assert set(att["jobs"]) == {"alpha", "beta", "-"}
+    assert att["jobs"]["alpha"]["epochs"] == 2
+    assert att["jobs"]["beta"]["epochs"] == 1
+    assert "train" in att["jobs"]["alpha"]["phases"]
+    assert (
+        att["jobs"]["alpha"]["phases"]["train"]
+        <= att["jobs"]["alpha"]["wall_s"] + 1e-6
+    )
+
+
+def test_job_tag_is_thread_local():
+    """Concurrent tenants on their own threads must not cross-stamp."""
+    tr = Tracer(mode="on")
+    barrier = threading.Barrier(2)
+
+    def tenant(job):
+        tr.set_job(job)
+        tr.set_epoch(0)
+        barrier.wait()  # both threads tagged before either emits
+        with tr.span("epoch", cat=EPOCH_CAT):
+            pass
+        tr.set_epoch(None)
+        tr.set_job(None)
+
+    threads = [
+        threading.Thread(target=tenant, args=(j,)) for j in ("a", "b")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    att = attribution_by_job(tr.chrome_events())
+    assert set(att["jobs"]) == {"a", "b"}
+    assert all(info["epochs"] == 1 for info in att["jobs"].values())
+
+
 # ----------------------------------------------------------------------- CLI
 
 
@@ -208,6 +264,27 @@ def test_cli_summarize_epoch_filter_and_errors(saved_trace, capsys):
     capsys.readouterr()
     assert scope_main(["summarize", saved_trace, "--epoch", "7"]) == 2
     assert scope_main(["summarize", str(saved_trace) + ".missing"]) == 2
+
+
+def test_cli_summarize_by_job(tmp_path, capsys):
+    tr = Tracer(mode="on")
+    tr.set_job("tenant0")
+    tr.set_epoch(0)
+    with tr.span("epoch", cat=EPOCH_CAT):
+        with tr.span("train"):
+            pass
+    tr.set_epoch(None)
+    tr.set_job(None)
+    path = tr.save(str(tmp_path / "ms.trace.json"))
+    assert scope_main(["summarize", path, "--by-job"]) == 0
+    out = capsys.readouterr().out
+    assert "tenant0" in out and "top phases" in out
+    assert scope_main(["summarize", path, "--by-job", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["jobs"]["tenant0"]["epochs"] == 1
+    assert "train" in payload["jobs"]["tenant0"]["phases"]
+    # per-epoch filtering and per-tenant grouping are different reports
+    assert scope_main(["summarize", path, "--by-job", "--epoch", "0"]) == 2
 
 
 def test_cli_diff(saved_trace, tmp_path, capsys):

@@ -113,6 +113,30 @@ def test_packed_without_device_cache_bitwise_equal(bundle):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_packed_zero1_engages_and_matches_elastic(bundle):
+    """shard_update no longer forces packed epochs back to windowed
+    dispatch (the PR-13 fallback, closed in PR 18): the fused shard body
+    routes ZeRO-1 on the 1-chip mesh (identity collectives), so the packed
+    scan must engage under --shard_update and track the elastic zero-1
+    path's balancer trajectory exactly."""
+    tr_e, rec_e = _run(bundle, packed="off", shard_update=True)
+    tr_p, rec_p = _run(bundle, packed="auto", shard_update=True)
+    assert tr_p._can_use_packed(None)
+    np.testing.assert_allclose(
+        rec_e.data["partition"], rec_p.data["partition"], atol=1e-9
+    )
+    for rec in (rec_e, rec_p):
+        losses = rec.data["train_loss"]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0] * 1.2
+    # the packed scan compiled and the elastic hot loop never dispatched
+    assert tr_p.steps.fused_epoch_idx._cache_size() >= 1 or (
+        tr_p._aot is not None
+        and any(k[0] == "fused_epoch_idx" for k in tr_p._aot.keys())
+    )
+    assert tr_p.steps.worker_step_acc._cache_size() == 0
+    assert tr_p.steps.worker_step_acc_idx._cache_size() == 0
+
+
 def test_packed_on_requires_topology(bundle):
     cfg = Config(
         debug=True, world_size=4, batch_size=128, epoch_size=1,

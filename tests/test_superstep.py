@@ -88,6 +88,42 @@ def test_superstep_scan_bitwise_parity(bundle):
     assert tr_on.steps.worker_step_acc_idx._cache_size() == 0
 
 
+def test_superstep_scan_zero1_bitwise_parity(bundle):
+    """shard_update x scan mode (the PR-13 fallback, closed in PR 18): the
+    superstep body routes into the axis-free ZeRO-1 twin
+    (``_zero1_update(with_comm=False, local_index=0)``) — on the 1-device
+    mesh that scan mode requires, the windowed combine twin's collectives
+    are identities, so the compiled window must match the per-step zero-1
+    cadence bit for bit."""
+    tr_off, rec_off = _run(
+        bundle, superstep="off", device=0, shard_update=True
+    )
+    tr_on, rec_on = _run(
+        bundle, superstep="auto", device=0, shard_update=True
+    )
+    assert tr_on._elastic_mode() == "scan"
+    assert tr_off._elastic_mode() == "step"
+    _assert_bitwise_equal(tr_off, rec_off, tr_on, rec_on)
+    # the scan actually carried the sharded state (and donation stayed off
+    # — the XLA:CPU donated-carry sanction, steps.py _state_donate)
+    assert tr_on.steps.superstep_cache_size() >= 1
+    assert tr_on.steps._state_donate == ()
+
+
+def test_superstep_scan_zero1_compress_stays_windowed(bundle):
+    """The one remaining exclusion: shard_update x compress_grads keeps
+    the windowed cadence (stochastic rounding is not an identity even
+    over a size-1 axis, so the scan's comm-free twin would diverge)."""
+    cfg = Config(
+        debug=True, world_size=4, batch_size=128, epoch_size=1,
+        dataset="mnist", model="mnistnet", dynamic_batch_size=False,
+        device=0, superstep="auto", packed="off",
+        shard_update=True, compress_grads="int8",
+    )
+    tr = Trainer(cfg, bundle=bundle, log_to_file=False)
+    assert tr._elastic_mode() == "window"
+
+
 @pytest.mark.slow
 def test_superstep_windowed_bitwise_parity(bundle):
     """Multi-device topology (round-robin over the mesh): the per-step

@@ -913,7 +913,12 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
                     ab["switches_per_epoch"] = [int(v) for v in sw]
                     ab["switch_count"] = int(sum(sw))
                     if tr._rebalance_ctl is not None:
-                        ab["controller"] = tr._rebalance_ctl.snapshot()
+                        # include_journal: the bench artifact doubles as a
+                        # replay-lab corpus (balance/replaylab.load_corpus
+                        # reads this section directly — ISSUE 19 harvest)
+                        ab["controller"] = tr._rebalance_ctl.snapshot(
+                            include_journal=True
+                        )
                     ab["rebalance_events"] = tr.recorder.meta.get(
                         "rebalance_events", []
                     )
@@ -922,6 +927,51 @@ def run_arms(out_path: str, force_cpu: bool, resume_path: str = "") -> int:
                     ab["epoch_wall_s"] / ab["window_wall_s"], 3
                 )
             out["instr"]["online_dbs_ab"] = ab
+        _write_atomic(out_path, out)
+
+    if (
+        force_cpu
+        and os.environ.get("BENCH_CONTROLLER_SWEEP", "1") == "1"
+        and "controller_sweep" not in out["instr"]
+    ):
+        if resume.get("instr", {}).get("controller_sweep"):
+            out["instr"]["controller_sweep"] = resume["instr"][
+                "controller_sweep"
+            ]
+        else:
+            # Device-free controller-knob sweep (ISSUE 19): the replay
+            # lab's small grid over the stock synthesized scenario library
+            # (every ScheduledStragglerInjector schedule family), ranked by
+            # geometric-mean speedup over the never-switch hold baseline.
+            # Pure host-side numpy — records the best-found knob set
+            # against the shipped defaults, plus the invariant-checker
+            # verdict over every simulated journal.
+            try:
+                from dynamic_load_balance_distributeddnn_tpu.balance import (
+                    replaylab,
+                )
+
+                t0 = time.time()
+                report = replaylab.sweep(
+                    replaylab.builtin_scenarios(4),
+                    replaylab.knob_grid("small"),
+                )
+                out["instr"]["controller_sweep"] = {
+                    "scenarios": report["scenarios"],
+                    "candidates": report["candidates"],
+                    "best": report["best"],
+                    "default": report["default"],
+                    "best_vs_default": report["best_vs_default"],
+                    "invariant_violations": report["invariant_violations"],
+                    "top5": [
+                        {k: r[k] for k in ("knobs", "score", "switches")}
+                        for r in report["results"][:5]
+                    ],
+                    "sweep_wall_s": round(time.time() - t0, 3),
+                }
+            except Exception as e:
+                sys.stderr.write(f"[bench] controller_sweep failed: {e}\n")
+                out["instr"]["controller_sweep"] = {"error": str(e)[:300]}
         _write_atomic(out_path, out)
 
     if (

@@ -135,7 +135,7 @@ class OnlineRebalanceController:
         self.global_batch = int(global_batch)
         self.groups = [list(g) for g in groups if len(g)]
         self.bucket = int(bucket)
-        self.max_share = max_share
+        self.max_share = float(max_share) if max_share is not None else None
         self.hysteresis = float(hysteresis)
         self.margin = float(margin)
         self.budget_frac = float(budget_frac)
@@ -166,6 +166,63 @@ class OnlineRebalanceController:
         # bounded; mirrored as graftscope ``decision`` instants when tracing
         # is enabled and surfaced by `graftscope decisions`.
         self.journal: deque = deque(maxlen=JOURNAL_CAP)
+        # ring evictions: a replayed corpus must be honest about truncation —
+        # a journal that silently lost its head is not the full history
+        self.journal_dropped = 0
+        # engine-owned position tag ({"epoch": e, "window": w}) merged into
+        # every journal entry at decision time, so HOLD verdicts carry their
+        # epoch too (commit() only annotates executed switches) and the
+        # `graftscope decisions --since` filter has something to cut on
+        self.eval_context: Dict = {}
+        self._config_traced = False
+
+    # ---------------------------------------------------------- replay seam
+
+    def journal_config(self) -> Dict:
+        """The construction surface a replay needs to rebuild THIS controller
+        (balance/replaylab.py): topology + knobs, JSON-safe. Carried in the
+        registry snapshot and (once, lazily) as a ``dbs_config`` trace
+        instant so spools and traces are self-describing corpora."""
+        return {
+            "world_size": self.world_size,
+            "global_batch": self.global_batch,
+            "groups": [list(g) for g in self.groups],
+            "bucket": self.bucket,
+            "max_share": self.max_share,
+            "hysteresis": self.hysteresis,
+            "margin": self.margin,
+            "budget_frac": self.budget_frac,
+            "rate_alpha": self.rate_alpha,
+            "cost_init": self.cost_init,
+        }
+
+    @classmethod
+    def from_journal_config(
+        cls, config: Dict, **knob_overrides
+    ) -> "OnlineRebalanceController":
+        """Rebuild a fresh controller from a recorded ``journal_config()``,
+        optionally overriding the decision knobs (hysteresis / margin /
+        budget_frac / rate_alpha / cost_init) for counterfactual replay."""
+        kw = {
+            "bucket": int(config.get("bucket", 0)),
+            "max_share": config.get("max_share"),
+            "hysteresis": float(config.get("hysteresis", 0.1)),
+            "margin": float(config.get("margin", 3.0)),
+            "budget_frac": float(config.get("budget_frac", 0.5)),
+            "rate_alpha": float(config.get("rate_alpha", 0.5)),
+            "cost_init": float(config.get("cost_init", 0.01)),
+        }
+        for k, v in knob_overrides.items():
+            if k not in kw:
+                raise ValueError(f"unknown controller knob override: {k!r}")
+            if v is not None:
+                kw[k] = float(v)
+        return cls(
+            int(config["world_size"]),
+            int(config["global_batch"]),
+            [list(g) for g in config["groups"]],
+            **kw,
+        )
 
     # ------------------------------------------------------------- signal
 
@@ -225,20 +282,28 @@ class OnlineRebalanceController:
             "new_step_s": round(float(dec.new_step_s), 6),
             "cost_est_s": round(float(dec.cost_est_s), 6),
             "remaining_steps": int(dec.remaining_steps),
-            "wall_scale": round(float(self.wall_scale), 4),
+            # replay INPUTS (balance/replaylab.py restores these before
+            # re-proposing): full precision, NOT rounded — JSON round-trips
+            # float64 exactly, and the decision gates sit at exact
+            # equalities often enough that a 1e-6 display round flips
+            # borderline verdicts and breaks bit-for-bit parity
+            "wall_scale": float(self.wall_scale),
+            "comm_step_s": float(self.comm_step_s),
             "hysteresis": self.hysteresis,
             "margin": self.margin,
             "budget_frac": self.budget_frac,
-            "spent_s": round(self.spent_s, 6),
-            "credit_s": round(self.credit_s, 6),
+            "spent_s": float(self.spent_s),
+            "credit_s": float(self.credit_s),
             "switch_cost_ema_s": (
-                round(self.switch_cost_s, 6)
+                float(self.switch_cost_s)
                 if self.switch_cost_s is not None
                 else None
             ),
         }
+        for k, v in self.eval_context.items():
+            ev.setdefault(k, v)
         if eff_rates is not None:
-            ev["eff_rates"] = [round(float(r), 9) for r in eff_rates]
+            ev["eff_rates"] = [float(r) for r in eff_rates]
         if cur_batches is not None:
             ev["cur_batches"] = [int(b) for b in cur_batches]
         if dec.candidate_batches is not None:
@@ -247,12 +312,24 @@ class OnlineRebalanceController:
             ev["candidate_shares"] = [
                 round(float(s), 6) for s in dec.candidate_shares
             ]
+        if len(self.journal) == self.journal.maxlen:
+            self.journal_dropped += 1
         self.journal.append(ev)
         tracer = get_tracer()
         if tracer.enabled:
+            if not self._config_traced:
+                # once per controller: the construction surface, so a spool
+                # or trace file alone is a replayable corpus
+                self._config_traced = True
+                tracer.instant(
+                    "dbs_config", cat="decision", args=self.journal_config()
+                )
             # a COPY: commit/note_deferred annotate the journal entry later,
             # and the trace must keep the verdict as decided
-            tracer.instant("dbs_decision", cat="decision", args=dict(ev))
+            args = dict(ev)
+            if self.journal_dropped:
+                args["journal_dropped"] = self.journal_dropped
+            tracer.instant("dbs_decision", cat="decision", args=args)
         return dec
 
     def decision_journal(self) -> List[Dict]:
@@ -286,7 +363,11 @@ class OnlineRebalanceController:
         self.last_candidate_batches = batches.copy()
         if np.array_equal(batches, b_cur):
             return self._record_decision(
-                SwitchDecision(False, "same-plan", batches, new_shares), c, b_cur
+                SwitchDecision(
+                    False, "same-plan", batches, new_shares,
+                    remaining_steps=int(remaining_steps),
+                ),
+                c, b_cur,
             )
         cur_step = (
             step_time(c, b_cur, self.groups, comm_s=self.comm_step_s)
@@ -387,9 +468,13 @@ class OnlineRebalanceController:
                 "dbs_deferred", cat="decision", args={"deferred": self.deferred}
             )
 
-    def snapshot(self) -> Dict:
-        """JSON-safe controller observability (recorder meta / registry)."""
-        return {
+    def snapshot(self, include_journal: bool = False) -> Dict:
+        """JSON-safe controller observability (recorder meta / registry).
+        ``include_journal=True`` additionally embeds the construction config
+        and the full decision journal — the shape `balance/replaylab.py`
+        loads as a replay corpus (the bench's ``online_dbs_ab`` arm and
+        `scripts/harvest_replay_corpus.py` harvest through this)."""
+        out = {
             "evals": self.evals,
             "switches": self.switches,
             "deferred": self.deferred,
@@ -403,5 +488,10 @@ class OnlineRebalanceController:
             "wall_scale": round(self.wall_scale, 4),
             "comm_step_s": round(self.comm_step_s, 6),
             "decisions": len(self.journal),
+            "journal_dropped": self.journal_dropped,
             "last_decision": dict(self.journal[-1]) if self.journal else None,
         }
+        if include_journal:
+            out["config"] = self.journal_config()
+            out["journal"] = self.decision_journal()
+        return out

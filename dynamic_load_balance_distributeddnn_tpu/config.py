@@ -534,14 +534,17 @@ class Config:
                                        # admissible.
     rebalance_rate_alpha: float = 0.5  # EMA weight on the newest per-worker
                                        # rate sample in the controller
-    fault_schedule: str = "none"       # "none"|"sin"|"ramp": time-VARYING
-                                       # straggler schedule over the
-                                       # --straggler factors (faults.py
+    fault_schedule: str = "none"       # "none"|"sin"|"ramp"|"spike"|
+                                       # "diurnal"|"brownout"|"killstorm":
+                                       # time-VARYING straggler schedule over
+                                       # the --straggler factors (faults.py
                                        # ScheduledStragglerInjector): factors
                                        # follow the schedule gain within
                                        # epochs — the scenario the window-
                                        # cadence controller exists for.
-                                       # none = the static profile.
+                                       # none = the static profile; brownout/
+                                       # killstorm draw per-worker victim
+                                       # sets from --seed.
     fault_period: float = 2.0          # schedule period in epochs (sin:
                                        # full cycle; ramp: rise time)
     packed: str = "auto"               # "auto"|"on"|"off": single-device
@@ -636,8 +639,13 @@ class Config:
             raise ValueError("rebalance_budget_frac must be > 0")
         if not 0.0 < self.rebalance_rate_alpha <= 1.0:
             raise ValueError("rebalance_rate_alpha must be in (0, 1]")
-        if self.fault_schedule not in ("none", "sin", "ramp"):
-            raise ValueError("fault_schedule must be 'none', 'sin' or 'ramp'")
+        if self.fault_schedule not in (
+            "none", "sin", "ramp", "spike", "diurnal", "brownout", "killstorm"
+        ):
+            raise ValueError(
+                "fault_schedule must be 'none', 'sin', 'ramp', 'spike', "
+                "'diurnal', 'brownout' or 'killstorm'"
+            )
         if self.fault_period <= 0:
             raise ValueError("fault_period must be > 0 epochs")
         if self.fault_schedule != "none" and not self.straggler:
@@ -961,10 +969,15 @@ def get_parser() -> argparse.ArgumentParser:
                    default=d.rebalance_rate_alpha,
                    help="EMA weight on the newest per-worker rate sample.")
     p.add_argument("--fault_schedule", type=str, default=d.fault_schedule,
-                   choices=["none", "sin", "ramp"],
+                   choices=["none", "sin", "ramp", "spike", "diurnal",
+                            "brownout", "killstorm"],
                    help="Time-varying straggler schedule over the "
                         "--straggler factors (sin: smooth appear/disappear "
-                        "per period; ramp: rise once and hold).")
+                        "per period; ramp: rise once and hold; spike: full "
+                        "factor for the duty fraction of each period; "
+                        "diurnal: day/night load plateau; brownout: seeded "
+                        "contiguous multi-worker slowdowns per period; "
+                        "killstorm: seeded random victim stalls per period).")
     p.add_argument("--fault_period", type=float, default=d.fault_period,
                    help="Schedule period in epochs.")
     p.add_argument("--packed", type=str, default=d.packed,

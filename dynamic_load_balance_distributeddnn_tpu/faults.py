@@ -174,13 +174,31 @@ class StaticStragglerInjector(FaultInjector):
 class ScheduledStragglerInjector(StaticStragglerInjector):
     """Time-VARYING straggler profile — the scenario epoch-cadence DBS cannot
     touch (ISSUE 11). The per-worker slowdown factor follows a deterministic
-    schedule over fractional epoch-time ``t``:
+    schedule over fractional epoch-time ``t``. Fleet-wide (scalar-gain)
+    shapes:
 
     * ``sin``: factor_r(t) = 1 + (f_r - 1) * 0.5 * (1 - cos(2*pi*t/period))
       — smooth 0 -> full -> 0 per ``period`` epochs, so a straggler appears
       and disappears MID-epoch;
     * ``ramp``: gain rises linearly from 0 to 1 over ``period`` epochs and
-      holds — a worker that degrades once and stays degraded.
+      holds — a worker that degrades once and stays degraded;
+    * ``spike``: rectangular burst — gain 1 for the first ``duty`` fraction
+      of each period, 0 otherwise; the on/off edge a smooth EMA lags on
+      (the controller-lab fuzz shape for hysteresis tuning, ISSUE 19);
+    * ``diurnal``: a flattened daytime hump (sqrt of the positive sine
+      half-wave) followed by a flat night — the shared-fleet load curve.
+
+    Per-WORKER (vector-gain, seeded) shapes — which workers are hit varies
+    by event, drawn from explicit per-event ``random.Random`` streams so a
+    given ``seed`` replays bit-for-bit regardless of evaluation order:
+
+    * ``brownout``: once per period, a CONTIGUOUS block of workers browns
+      out together for a seeded sub-interval — correlated degradation (a
+      rack losing cooling), the case independent-noise models miss;
+    * ``killstorm``: once per period, a seeded victim set drops out at
+      staggered offsets for staggered durations — a preemption storm
+      expressed as slowdown factors (the injected factor stands in for a
+      near-dead worker).
 
     Two cadences of the same schedule:
 
@@ -191,9 +209,13 @@ class ScheduledStragglerInjector(StaticStragglerInjector):
       (balance/controller.py) folds into its EMA rate estimates, and the
       engine's window loop re-stages compute-mode injection from.
 
-    Deterministic (no rng): the realized schedule replays bit-for-bit, so
-    the window-vs-epoch cadence A/B (bench ``online_dbs_ab``) compares arms
-    under the identical injected trajectory."""
+    Deterministic for a given ``seed`` (sin/ramp/spike/diurnal use no rng at
+    all): the realized schedule replays bit-for-bit, so the window-vs-epoch
+    cadence A/B (bench ``online_dbs_ab``) compares arms under the identical
+    injected trajectory."""
+
+    SCALAR_SCHEDULES = ("sin", "ramp", "spike", "diurnal")
+    WORKER_SCHEDULES = ("brownout", "killstorm")
 
     def __init__(
         self,
@@ -202,34 +224,102 @@ class ScheduledStragglerInjector(StaticStragglerInjector):
         schedule: str = "sin",
         period: float = 2.0,
         phase: float = 0.0,
+        duty: float = 0.25,
+        seed: int = 0,
     ):
         super().__init__(factors, mode)
-        if schedule not in ("sin", "ramp"):
-            raise ValueError("schedule must be 'sin' or 'ramp'")
+        if schedule not in self.SCALAR_SCHEDULES + self.WORKER_SCHEDULES:
+            raise ValueError(
+                "schedule must be one of "
+                + "/".join(self.SCALAR_SCHEDULES + self.WORKER_SCHEDULES)
+            )
         if period <= 0:
             raise ValueError("period must be > 0 epochs")
+        if not 0.0 < duty <= 1.0:
+            raise ValueError("duty must be in (0, 1]")
         self.schedule = schedule
         self.period = float(period)
         self.phase = float(phase)
+        self.duty = float(duty)
+        self.seed = int(seed)
+
+    def _event_rng(self, n: int) -> random.Random:
+        """One independent stream per schedule event (period index ``n``):
+        re-derived on every evaluation, so the realized schedule is a pure
+        function of (seed, t) — no mutable rng state, no evaluation-order
+        dependence (the lab may probe t out of order)."""
+        return random.Random(self.seed * 1_000_003 + n * 7919 + 13)
 
     def gain(self, t: float) -> float:
-        """Schedule gain in [0, 1] at fractional epoch-time ``t``."""
+        """Scalar schedule gain in [0, 1] at fractional epoch-time ``t``
+        (fleet-wide shapes only; per-worker shapes go through
+        :meth:`gain_vec`)."""
         x = (float(t) - self.phase) / self.period
         if self.schedule == "sin":
             return 0.5 * (1.0 - np.cos(2.0 * np.pi * x))
-        return float(np.clip(x, 0.0, 1.0))
+        if self.schedule == "ramp":
+            return float(np.clip(x, 0.0, 1.0))
+        frac = x - np.floor(x)
+        if self.schedule == "spike":
+            return 1.0 if frac < self.duty else 0.0
+        if self.schedule == "diurnal":
+            return float(np.sqrt(max(0.0, np.sin(2.0 * np.pi * frac))))
+        raise ValueError(
+            f"schedule {self.schedule!r} is per-worker; use gain_vec"
+        )
+
+    def gain_vec(self, t: float) -> np.ndarray:
+        """Per-worker schedule gain in [0, 1] at epoch-time ``t``. Scalar
+        schedules broadcast; brownout/killstorm draw their victim sets and
+        sub-intervals from the per-event seeded streams."""
+        ws = len(self.factors)
+        if self.schedule in self.SCALAR_SCHEDULES:
+            return np.full(ws, self.gain(t), dtype=np.float64)
+        x = (float(t) - self.phase) / self.period
+        n = int(np.floor(x))
+        frac = x - np.floor(x)
+        rng = self._event_rng(n)
+        g = np.zeros(ws, dtype=np.float64)
+        if self.schedule == "brownout":
+            # one correlated event per period: a contiguous worker block
+            # (think "one rack") browns out together for a seeded window
+            k = rng.randint(2, max(2, ws // 2)) if ws > 1 else 1
+            start = rng.randrange(ws)
+            offset = rng.uniform(0.0, 0.5)
+            duration = rng.uniform(0.2, 0.5)
+            if offset <= frac < offset + duration:
+                for i in range(k):
+                    g[(start + i) % ws] = 1.0
+            return g
+        # killstorm: a seeded victim set with STAGGERED drop/return edges
+        # inside the storm window — never one tidy simultaneous outage
+        n_victims = rng.randint(1, max(1, ws - 1)) if ws > 1 else 1
+        victims = rng.sample(range(ws), n_victims)
+        for v in victims:
+            offset = rng.uniform(0.0, 0.6)
+            duration = rng.uniform(0.1, 0.4)
+            if offset <= frac < offset + duration:
+                g[v] = 1.0
+        return g
 
     def factors_at(self, t: float) -> np.ndarray:
         """Instantaneous per-worker slowdown factors at epoch-time ``t``."""
-        return 1.0 + (self.factors - 1.0) * self.gain(t)
+        if self.schedule in self.SCALAR_SCHEDULES:
+            # the historical scalar-broadcast expression, kept verbatim so
+            # sin/ramp trajectories stay bit-identical across releases
+            return 1.0 + (self.factors - 1.0) * self.gain(t)
+        return 1.0 + (self.factors - 1.0) * self.gain_vec(t)
 
     def _mean_factors(self, epoch: float) -> np.ndarray:
         # numeric mean over the epoch (64 midpoints): deterministic, exact
         # enough for a signal that is itself probe-noise-limited, and one
         # formula serves every schedule shape
         ts = epoch + (np.arange(64) + 0.5) / 64.0
-        g = float(np.mean([self.gain(t) for t in ts]))
-        return 1.0 + (self.factors - 1.0) * g
+        if self.schedule in self.SCALAR_SCHEDULES:
+            g = float(np.mean([self.gain(t) for t in ts]))
+            return 1.0 + (self.factors - 1.0) * g
+        g_vec = np.mean([self.gain_vec(t) for t in ts], axis=0)
+        return 1.0 + (self.factors - 1.0) * g_vec
 
     def _to_faults(self, factors: np.ndarray, ctx) -> EpochFaults:
         ws = len(self.factors)

@@ -97,16 +97,17 @@ class MetricsRegistry:
         self.aot_service = None  # runtime.compiler.AOTCompileService
         self.health = None  # runtime.health.WorkerHealth
         self.controller = None  # balance.controller.OnlineRebalanceController
+        self.scheduler = None  # runtime.scheduler.MultiStreamEngine
 
     def attach(self, **surfaces) -> "MetricsRegistry":
         """Register observability surfaces by their well-known slot name
         (``host_meter``, ``compile_tracker``, ``aot_service``, ``health``,
-        ``controller``). Unknown names raise — a typo'd attach would
-        silently hollow the snapshot."""
+        ``controller``, ``scheduler``). Unknown names raise — a typo'd
+        attach would silently hollow the snapshot."""
         for name, obj in surfaces.items():
             if name not in (
                 "host_meter", "compile_tracker", "aot_service", "health",
-                "controller",
+                "controller", "scheduler",
             ):
                 raise ValueError(f"unknown registry surface {name!r}")
             setattr(self, name, obj)
@@ -200,4 +201,9 @@ class MetricsRegistry:
             # ledgers, decision count, and the most recent verdict with the
             # inputs it was decided on
             out["controller"] = self.controller.snapshot()
+        if self.scheduler is not None:
+            # the OUTER loop's decision journal (ISSUE 19): the many-stream
+            # engine's per-window device-allocation verdicts in the same
+            # journal shape as the inner controller's
+            out["scheduler"] = self.scheduler.snapshot()
         return out

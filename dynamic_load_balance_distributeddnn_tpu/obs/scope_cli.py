@@ -11,6 +11,9 @@ Usage::
     graftscope merge run.trace.json -o merged.json        # + worker traces
     graftscope postmortem spools/                         # crash stitcher
     graftscope decisions traces/run.trace.json            # DBS journal
+    graftscope decisions spools/ --outcome committed --csv  # filtered export
+    graftscope replay runs/bench.json --margin 6          # counterfactual
+    graftscope sweep --grid small --random 8              # knob sweep
     graftscope conformance spools/                        # protocol replay
 
 ``summarize`` and ``merge`` automatically stitch compile-worker trace files
@@ -25,9 +28,24 @@ tolerated) and any sibling ``*.trace.json`` in a directory into ONE
 pid-tagged Perfetto trace — survivors' rendezvous state-machine spans next
 to the victim's last spooled events, realigned by each file's unix-time
 base — and prints a textual incident report (detection → drain → rebuild
-per process). ``decisions`` renders the online-DBS controller's decision
-journal (every switch/hold verdict with the inputs it was decided on) from
-a trace or spool, so "why did epoch 7 rebalance?" is answerable offline.
+per process). ``decisions`` renders the decision journal — the online-DBS
+controller's switch/hold verdicts AND the outer many-stream allocator's
+``pool_decision`` rows — with each row's derived outcome, filterable by
+``--outcome``/``--since`` and exportable with ``--csv``, so "why did epoch
+7 rebalance?" is answerable offline.
+
+``replay`` and ``sweep`` (ISSUE 19) are the device-free controller lab
+(balance/replaylab.py): ``replay`` re-runs a recorded decision journal
+(bench artifact, trace, spool, or spool directory) through a fresh
+controller — with no overrides it is a strict parity gate (every recorded
+verdict must reproduce bit-for-bit), with ``--hysteresis/--margin/
+--budget-frac/--rate-alpha`` it answers the counterfactual "what would the
+run have done under different knobs". ``sweep`` grids (and optionally
+randomizes) knobs over the synthesized scenario library — spike bursts,
+correlated rack brownouts, diurnal load, kill-storms — and ranks them by
+geomean speedup over the never-switch baseline. Both check every journal
+against the controller invariants (switch spend within budget, no switch
+without modeled gain clearing the gates, ledger monotonicity).
 
 ``conformance`` (ISSUE 16, graftrdzv) replays the recorded ``rdzv_*``
 instants of every spool/trace under a directory against the rendezvous
@@ -38,8 +56,9 @@ generation must agree on roster and coordinator — so each real chaos-test
 postmortem doubles as a checked protocol trace.
 
 Exit status: 0 on success, 1 when ``conformance`` finds protocol
+violations or ``replay``/``sweep`` find parity drift / invariant
 violations, 2 on usage/IO errors (including an empty or missing spool
-directory).
+directory, or a ``decisions`` query matching no rows).
 """
 
 from __future__ import annotations
@@ -606,47 +625,267 @@ def _decision_events(path: str) -> List[dict]:
     ]
 
 
-def decisions(path: str, as_json: bool = False) -> str:
-    """Render the online-DBS controller's decision journal: one row per
-    evaluation with verdict, reason, and the inputs behind it (modeled
-    step walls, predicted win, cost estimate, ledgers)."""
-    evs = _decision_events(path)
+def _paired_decisions(evs: List[dict]) -> "tuple[List[dict], int]":
+    """Normalize decision instants into rows with a derived ``outcome``.
+    The live journal annotates outcomes in place, but the trace stream
+    keeps each ``dbs_decision`` as decided and interleaves ``dbs_switch``/
+    ``dbs_deferred`` after it — pairing re-derives what actually happened.
+    Also returns the largest ``journal_dropped`` count seen (ring-eviction
+    honesty for the header). ``dbs_config`` instants are construction
+    metadata, not verdicts — skipped here (the replay lab reads them)."""
+    rows: List[dict] = []
+    last: Optional[dict] = None
+    dropped = 0
+    for e in evs:
+        name = e.get("name")
+        a = dict(e.get("args") or {})
+        dropped = max(dropped, int(a.get("journal_dropped", 0) or 0))
+        if name == "dbs_config":
+            continue
+        row = {"name": name, "ts": e.get("ts"), "args": a}
+        if name in ("dbs_decision", "pool_decision"):
+            row["outcome"] = a.get("outcome") or (
+                "pending" if a.get("switch") else "hold"
+            )
+            last = row
+        elif name == "dbs_switch":
+            row["outcome"] = "committed"
+            if last is not None and last["name"] == "dbs_decision":
+                last["outcome"] = "committed"
+        elif name == "dbs_deferred":
+            row["outcome"] = "deferred"
+            if last is not None and last["name"] == "dbs_decision":
+                last["outcome"] = "deferred"
+        rows.append(row)
+    return rows, dropped
+
+
+def _decision_row_cells(row: dict) -> List[str]:
+    a = row["args"]
+    if row["name"] == "dbs_deferred":
+        return ["-", "-", "deferred", "-", "-", "-", "-", "-",
+                "engine warm-gate", row["outcome"]]
+    if row["name"] == "pool_decision":
+        # the OUTER loop's verdicts (many-stream device allocation): the
+        # win column carries the modeled makespan gain, the batches column
+        # the proposed per-tenant device counts
+        verdict = "MIGRATE" if a.get("switch") else "hold"
+        gain = a.get("modeled_gain")
+        return [
+            str(a.get("epoch", "-")),
+            str(a.get("window", "-")),
+            verdict,
+            a.get("reason", "-"),
+            "-" if gain is None else f"{gain:.4f}",
+            "-", "-", "-",
+            str(a.get("proposed_counts", "-")),
+            row["outcome"],
+        ]
+    verdict = "SWITCH" if a.get("switch") else "hold"
+    if row["name"] == "dbs_switch":
+        verdict = "committed"
+    return [
+        str(a.get("epoch", a.get("eval", "-"))),
+        str(a.get("window", "-")),
+        verdict,
+        a.get("reason", "-"),
+        f"{a.get('predicted_win_s', 0.0):.4f}",
+        f"{a.get('cur_step_s', 0.0):.4f}",
+        f"{a.get('new_step_s', 0.0):.4f}",
+        f"{a.get('cost_est_s', a.get('switch_cost_s', 0.0)):.4f}",
+        str(a.get("candidate_batches", a.get("batches", "-"))),
+        row["outcome"],
+    ]
+
+
+_DECISION_HEADER = ["epoch", "win", "verdict", "reason", "win_s", "cur_step",
+                    "new_step", "cost_s", "batches", "outcome"]
+
+
+def decisions(
+    path: str,
+    as_json: bool = False,
+    outcome: Optional[str] = None,
+    since: Optional[int] = None,
+    as_csv: bool = False,
+) -> str:
+    """Render the decision journal (inner DBS controller AND the outer
+    many-stream allocator): one row per evaluation with verdict, reason,
+    derived outcome, and the inputs behind it. ``outcome`` filters to
+    committed/deferred/hold rows; ``since`` keeps rows at epoch >= N (rows
+    with no epoch tag are dropped under the filter); ``as_csv`` exports
+    the table machine-readably. An empty result — no decision events at
+    all, or none surviving the filters — raises (exit 2), consistent with
+    postmortem/conformance."""
+    rows, dropped = _paired_decisions(_decision_events(path))
+    if outcome is not None:
+        rows = [r for r in rows if r["outcome"] == outcome]
+    if since is not None:
+        rows = [
+            r
+            for r in rows
+            if r["args"].get("epoch") is not None
+            and int(r["args"]["epoch"]) >= int(since)
+        ]
+    if not rows:
+        raise ValueError(
+            f"no controller decision events under {path}"
+            + (" (after filters)" if outcome is not None or since is not None
+               else " (run with --rebalance window and --trace on|ring)")
+        )
     if as_json:
         return json.dumps(
-            [{"name": e.get("name"), "ts": e.get("ts"), **(e.get("args") or {})}
-             for e in evs]
-        )
-    if not evs:
-        return "no controller decision events (run with --rebalance window and --trace on|ring)"
-    rows = []
-    for e in evs:
-        a = e.get("args") or {}
-        if e.get("name") == "dbs_deferred":
-            rows.append(
-                ["-", "-", "deferred", "-", "-", "-", "-", "-", "engine warm-gate"]
-            )
-            continue
-        verdict = "SWITCH" if a.get("switch") else "hold"
-        if e.get("name") == "dbs_switch":
-            verdict = "committed"
-        rows.append(
             [
-                str(a.get("epoch", a.get("eval", "-"))),
-                str(a.get("window", "-")),
-                verdict,
-                a.get("reason", "-"),
-                f"{a.get('predicted_win_s', 0.0):.4f}",
-                f"{a.get('cur_step_s', 0.0):.4f}",
-                f"{a.get('new_step_s', 0.0):.4f}",
-                f"{a.get('cost_est_s', a.get('switch_cost_s', 0.0)):.4f}",
-                str(a.get("candidate_batches", a.get("batches", "-"))),
+                {"name": r["name"], "ts": r["ts"], "outcome": r["outcome"],
+                 **r["args"]}
+                for r in rows
             ]
         )
-    return _fmt_table(
-        rows,
-        ["epoch", "win", "verdict", "reason", "win_s", "cur_step",
-         "new_step", "cost_s", "batches"],
+    cells = [_decision_row_cells(r) for r in rows]
+    if as_csv:
+        import csv
+        import io
+
+        buf = io.StringIO()
+        w = csv.writer(buf)
+        w.writerow(_DECISION_HEADER)
+        w.writerows(cells)
+        return buf.getvalue().rstrip("\n")
+    head = f"{len(rows)} decision row(s)"
+    if dropped:
+        head += (
+            f" — journal_dropped={dropped} older evaluation(s) evicted "
+            "from the ring (the journal head is truncated)"
+        )
+    return head + "\n" + _fmt_table(cells, _DECISION_HEADER)
+
+
+# ---------------------------------------------------------- controller lab
+
+
+def replay_cmd(
+    path: str, knobs: Dict, as_json: bool = False
+) -> "tuple[str, bool]":
+    """``graftscope replay``: re-run a recorded decision journal through a
+    fresh controller (balance/replaylab.py). With no knob overrides this
+    is the strict parity gate; with overrides it is a counterfactual.
+    Returns ``(rendered, ok)`` — ``ok=False`` (exit 1) on parity drift or
+    invariant violations."""
+    from dynamic_load_balance_distributeddnn_tpu.balance import replaylab
+
+    overrides = {k: v for k, v in knobs.items() if v is not None}
+    corpus = replaylab.load_corpus(path)
+    report = replaylab.replay(corpus, knobs=overrides or None)
+    ok = not report["mismatches"] and not report["invariant_violations"]
+    if as_json:
+        return json.dumps(report), ok
+    lines = [
+        f"replay: {report['entries']} journal entr(ies) from "
+        f"{report.get('label')} [{report['mode']}]",
+        "  knobs: "
+        + ", ".join(f"{k}={v}" for k, v in report["knobs"].items()),
+        f"  recorded: {report['recorded']['switches']} switch(es), "
+        f"{report['recorded']['deferred']} deferred, modeled wall "
+        f"{report['recorded']['modeled_wall_s']}s "
+        f"(spend {report['recorded']['switch_spend_s']}s)",
+        f"  replayed: {report['replayed']['switches']} switch(es), "
+        f"{report['replayed']['deferred']} deferred, modeled wall "
+        f"{report['replayed']['modeled_wall_s']}s "
+        f"(spend {report['replayed']['switch_spend_s']}s, ledger "
+        f"spent {report['replayed']['spent_s']}s / credit "
+        f"{report['replayed']['credit_s']}s)",
+        f"  never-switch hold wall: {report['hold_modeled_wall_s']}s",
+    ]
+    if report["mode"] == "strict":
+        lines.append(
+            "  parity: OK — recorded verdict sequence reproduced"
+            if report["parity"]
+            else f"  parity: DRIFT — {len(report['mismatches'])} mismatch(es)"
+        )
+        for m in report["mismatches"][:10]:
+            lines.append(f"    entry {m['index']}: {m['field']} — {m['detail']}")
+    for v in report["invariant_violations"][:10]:
+        lines.append(
+            f"  INVARIANT VIOLATION @ eval {v['eval']}: {v['invariant']} "
+            f"({v['detail']})"
+        )
+    if report["invariant_violations"]:
+        lines.append(
+            f"  invariants: {len(report['invariant_violations'])} violation(s)"
+        )
+    else:
+        lines.append("  invariants: clean")
+    return "\n".join(lines), ok
+
+
+def sweep_cmd(
+    scenarios: Optional[str],
+    world_size: int,
+    grid: str,
+    n_random: int,
+    seed: int,
+    as_json: bool = False,
+    out: Optional[str] = None,
+) -> "tuple[str, bool]":
+    """``graftscope sweep``: device-free knob sweep over the synthesized
+    scenario library, ranked by geometric-mean speedup over the hold
+    baseline. ``ok=False`` (exit 1) when any simulated journal violates
+    the controller invariants."""
+    from dynamic_load_balance_distributeddnn_tpu.balance import replaylab
+
+    lib = replaylab.builtin_scenarios(world_size)
+    if scenarios:
+        want = [s.strip() for s in scenarios.split(",") if s.strip()]
+        by_name = {sc.name: sc for sc in lib}
+        unknown = [w for w in want if w not in by_name]
+        if unknown:
+            raise ValueError(
+                f"unknown scenario(s) {unknown}; available: "
+                + ", ".join(sorted(by_name))
+            )
+        lib = [by_name[w] for w in want]
+    knob_sets = replaylab.knob_grid(grid)
+    if n_random > 0:
+        knob_sets = knob_sets + replaylab.random_knobs(n_random, seed=seed)
+    report = replaylab.sweep(lib, knob_sets)
+    ok = report["invariant_violations"] == 0
+    if out:
+        with open(out, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+    if as_json:
+        return json.dumps(report), ok
+    rows = [
+        [
+            str(i + 1),
+            json.dumps(r["knobs"]) if isinstance(r["knobs"], dict)
+            else r["knobs"],
+            f"{r['score']:.4f}",
+            str(r["switches"]),
+            f"{r['spent_s']:.4f}",
+        ]
+        for i, r in enumerate(report["results"][:10])
+    ]
+    lines = [
+        f"sweep: {report['candidates']} knob set(s) x "
+        f"{len(report['scenarios'])} scenario(s) "
+        f"({', '.join(report['scenarios'])})",
+        _fmt_table(rows, ["rank", "knobs", "speedup_vs_hold", "switches",
+                          "spent_s"]),
+    ]
+    if report["best"] and report["default"]:
+        lines.append(
+            f"best {report['best']['score']:.4f} vs default "
+            f"{report['default']['score']:.4f} "
+            f"(x{report['best_vs_default']})"
+        )
+    lines.append(
+        "invariants: clean across every simulated journal"
+        if ok
+        else f"invariants: {report['invariant_violations']} VIOLATION(S)"
     )
+    if out:
+        lines.append(f"full ranked report -> {out}")
+    return "\n".join(lines), ok
 
 
 # ------------------------------------------------------------ conformance
@@ -761,6 +1000,47 @@ def build_parser() -> argparse.ArgumentParser:
     )
     dc.add_argument("path")
     dc.add_argument("--json", action="store_true")
+    dc.add_argument("--outcome", choices=("committed", "deferred", "hold"),
+                    default=None,
+                    help="only rows whose derived outcome matches")
+    dc.add_argument("--since", type=int, default=None, metavar="EPOCH",
+                    help="only rows at epoch >= EPOCH (rows with no epoch "
+                    "tag, e.g. outer pool_decision rows, are dropped)")
+    dc.add_argument("--csv", action="store_true",
+                    help="CSV export of the decision table")
+    rp = sub.add_parser(
+        "replay",
+        help="controller lab: re-run a recorded decision journal (corpus "
+        "JSON, trace, spool, or spool directory) through a fresh "
+        "controller — strict parity gate by default, counterfactual with "
+        "knob overrides (exit 1 on parity drift or invariant violations)",
+    )
+    rp.add_argument("path", help="corpus/snapshot JSON, trace file, .spool, "
+                    "or spool directory")
+    rp.add_argument("--hysteresis", type=float, default=None)
+    rp.add_argument("--margin", type=float, default=None)
+    rp.add_argument("--budget-frac", type=float, default=None)
+    rp.add_argument("--rate-alpha", type=float, default=None)
+    rp.add_argument("--json", action="store_true")
+    sw = sub.add_parser(
+        "sweep",
+        help="controller lab: device-free knob sweep over the synthesized "
+        "scenario library (spike/brownout/diurnal/kill-storm ...), ranked "
+        "by geomean speedup over the hold baseline (exit 1 on invariant "
+        "violations in any simulated journal)",
+    )
+    sw.add_argument("--scenarios", default=None,
+                    help="comma-separated subset of builtin scenario names "
+                    "(default: all)")
+    sw.add_argument("--world-size", type=int, default=4)
+    sw.add_argument("--grid", choices=("small", "full"), default="small",
+                    help="knob grid density (default small: 18 points)")
+    sw.add_argument("--random", type=int, default=0, metavar="N",
+                    help="add N seeded log-uniform random knob sets")
+    sw.add_argument("--seed", type=int, default=0)
+    sw.add_argument("--json", action="store_true")
+    sw.add_argument("-o", "--out", default=None,
+                    help="also write the full ranked JSON report here")
     cf = sub.add_parser(
         "conformance",
         help="replay recorded rdzv_* instants against the rendezvous "
@@ -802,7 +1082,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif args.cmd == "postmortem":
             print(postmortem(args.dir, out=args.out, as_json=args.json))
         elif args.cmd == "decisions":
-            print(decisions(args.path, as_json=args.json))
+            print(
+                decisions(
+                    args.path,
+                    as_json=args.json,
+                    outcome=args.outcome,
+                    since=args.since,
+                    as_csv=args.csv,
+                )
+            )
+        elif args.cmd == "replay":
+            text, ok = replay_cmd(
+                args.path,
+                {
+                    "hysteresis": args.hysteresis,
+                    "margin": args.margin,
+                    "budget_frac": args.budget_frac,
+                    "rate_alpha": args.rate_alpha,
+                },
+                as_json=args.json,
+            )
+            print(text)
+            if not ok:
+                return 1
+        elif args.cmd == "sweep":
+            text, ok = sweep_cmd(
+                args.scenarios,
+                args.world_size,
+                args.grid,
+                args.random,
+                args.seed,
+                as_json=args.json,
+                out=args.out,
+            )
+            print(text)
+            if not ok:
+                return 1
         elif args.cmd == "conformance":
             text, ok = conformance(args.dir, as_json=args.json)
             print(text)

@@ -43,10 +43,14 @@ import dataclasses
 import logging
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dynamic_load_balance_distributeddnn_tpu.balance.controller import (
+    JOURNAL_CAP,
+)
 from dynamic_load_balance_distributeddnn_tpu.balance.solver import (
     equilibrium_shares,
     initial_partition,
@@ -55,6 +59,9 @@ from dynamic_load_balance_distributeddnn_tpu.balance.solver import (
     rebalance,
 )
 from dynamic_load_balance_distributeddnn_tpu.config import Config
+from dynamic_load_balance_distributeddnn_tpu.obs.registry import (
+    MetricsRegistry,
+)
 from dynamic_load_balance_distributeddnn_tpu.obs.trace import get_tracer
 from dynamic_load_balance_distributeddnn_tpu.runtime.health import (
     retry_transient,
@@ -288,6 +295,19 @@ class MultiStreamEngine:
         self._migrations_spent = 0
         self._membership_dirty = False
         self.windows: List[Dict] = []
+        # outer decision journal (ISSUE 19): EVERY per-window allocation
+        # verdict — hold or migrate — with the inputs it was decided on
+        # (epoch-wall EMAs, modeled gain, migration-budget state), in the
+        # same journal shape as the inner controller's so the controller
+        # lab and `graftscope decisions` cover BOTH nested DBS loops
+        self.evals = 0
+        self.actuations = 0
+        self.journal: deque = deque(maxlen=JOURNAL_CAP)
+        self.journal_dropped = 0
+        # the scheduler's own registry view: `obs.snapshot()["scheduler"]`
+        # is the outer journal's live surface, the pool twin of the inner
+        # controller's `["controller"]` section
+        self.obs = MetricsRegistry().attach(scheduler=self)
 
     # ------------------------------------------------------------ submit
 
@@ -476,24 +496,103 @@ class MultiStreamEngine:
             excess -= 1
         return out
 
+    def _record_outer_decision(
+        self,
+        live: List[JobState],
+        proposed: Dict[str, int],
+        current: Dict[str, int],
+        gain: Optional[float],
+        *,
+        switch: bool,
+        reason: str,
+        outcome: str,
+        membership_changed: bool,
+    ) -> None:
+        """Journal one outer evaluation (the many-stream twin of the inner
+        controller's ``_record_decision``) and mirror it as a graftscope
+        ``decision`` instant. Unlike the inner journal the outcome is known
+        at record time — actuation happens inline, there is no warm-gate
+        veto between verdict and execution."""
+        with self._lock:
+            walls = {
+                js.spec.job_id: (
+                    round(float(js.wall_ema), 6)
+                    if js.wall_ema is not None
+                    else None
+                )
+                for js in live
+            }
+            spent = int(self._migrations_spent)
+        ev: Dict = {
+            "eval": int(self.evals),
+            "switch": bool(switch),
+            "reason": reason,
+            "outcome": outcome,
+            "window": int(self._window),
+            "membership_changed": bool(membership_changed),
+            "wall_emas": walls,
+            "cur_counts": {k: int(v) for k, v in current.items()},
+            "proposed_counts": {k: int(v) for k, v in proposed.items()},
+            "modeled_gain": round(float(gain), 6) if gain is not None else None,
+            "outer_margin": self.outer_margin,
+            "migration_budget": self.migration_budget,
+            "migrations_spent": spent,
+        }
+        if len(self.journal) == self.journal.maxlen:
+            self.journal_dropped += 1
+        self.journal.append(ev)
+        tracer = get_tracer()
+        if tracer.enabled:
+            args = dict(ev)
+            if self.journal_dropped:
+                args["journal_dropped"] = self.journal_dropped
+            tracer.instant("pool_decision", cat="decision", args=args)
+
+    def decision_journal(self) -> List[Dict]:
+        """The outer journal as a JSON-safe list (oldest first)."""
+        return [dict(ev) for ev in self.journal]
+
     def _solve_and_actuate(
         self, live: List[JobState], membership_changed: bool
     ) -> None:
         proposed = self._outer_counts(live)
         with self._lock:
             current = {js.spec.job_id: len(js.devices) for js in live}
+        gain = self._modeled_gain(live, proposed)
+        self.evals += 1
+        record = lambda **kw: self._record_outer_decision(  # noqa: E731
+            live, proposed, current, gain,
+            membership_changed=membership_changed, **kw
+        )
         if proposed == current:
+            record(switch=False, reason="same-counts", outcome="hold")
             return
         if not membership_changed:
             if (
                 self.migration_budget is not None
                 and self._migrations_spent >= self.migration_budget
             ):
+                record(
+                    switch=False, reason="budget-exhausted", outcome="hold"
+                )
                 return
-            gain = self._modeled_gain(live, proposed)
-            if gain is None or gain <= self.outer_margin:
+            if gain is None:
+                # an unmeasured tenant means the gain model has no wall to
+                # stand on: only membership changes may actuate
+                record(
+                    switch=False, reason="unmeasured-hold", outcome="hold"
+                )
+                return
+            if gain <= self.outer_margin:
+                record(switch=False, reason="below-margin", outcome="hold")
                 return
         assigned = self.pool.reallocate(proposed)
+        self.actuations += 1
+        record(
+            switch=True,
+            reason="membership" if membership_changed else "migrate",
+            outcome="committed",
+        )
         get_tracer().instant(
             "pool_repartition",
             cat="scheduler",
@@ -775,3 +874,21 @@ class MultiStreamEngine:
             "migrations": self._migrations_spent,
             "jobs": jobs,
         }
+
+    def snapshot(self, include_journal: bool = False) -> Dict:
+        """JSON-safe outer-controller observability, shaped like the inner
+        controller's ``snapshot()`` (registry ``scheduler`` section)."""
+        out = {
+            "evals": self.evals,
+            "actuations": self.actuations,
+            "migrations_spent": int(self._migrations_spent),
+            "migration_budget": self.migration_budget,
+            "outer_margin": self.outer_margin,
+            "pool_devices": self.pool.n_devices,
+            "decisions": len(self.journal),
+            "journal_dropped": self.journal_dropped,
+            "last_decision": dict(self.journal[-1]) if self.journal else None,
+        }
+        if include_journal:
+            out["journal"] = self.decision_journal()
+        return out

@@ -379,6 +379,7 @@ class Trainer:
                 mode=cfg.fault_mode,
                 schedule=cfg.fault_schedule,
                 period=cfg.fault_period,
+                seed=cfg.seed,
             )
         elif cfg.straggler:
             self.injector = StaticStragglerInjector(
@@ -4985,6 +4986,10 @@ class Trainer:
                     )
                 eval_state["t"] = now
                 eval_state["step"] = ranges[i][1]
+            # position tag merged into the journal entry at decision time
+            # (ISSUE 19): HOLD verdicts carry their epoch/window too, not
+            # just the committed switches commit() annotates
+            ctl.eval_context = {"epoch": int(epoch), "window": int(j)}
             dec = ctl.propose(eff, cur_batches, remaining)
             keys: tuple = ()
             if dec.candidate_batches is not None and not np.array_equal(

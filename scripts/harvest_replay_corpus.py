@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Regenerate the checked-in replay-corpus JSONs (tests/corpus_replay/).
+
+The corpus is the tier-1 regression gate for the controller's decision
+rule (tests/test_replaylab.py): each file is a recorded decision journal
+plus the ``journal_config()`` that produced it, and the gate asserts that
+a FRESH controller replayed over the recorded inputs reproduces every
+recorded verdict bit-for-bit and that the whole trajectory satisfies the
+controller invariants. Run this script ONLY when the decision rule
+changes on purpose — a diff in the regenerated corpus is the review
+artifact showing exactly which verdicts moved.
+
+Two corpus sources, both device-free and fully deterministic:
+
+* ``sim-*`` — closed-loop scenario simulations through the REAL
+  ``OnlineRebalanceController`` (balance/replaylab.py ``simulate``), one
+  per library scenario family (scalar schedule, per-worker brownout,
+  kill-storm);
+* ``engine-linear-ramp`` — a synthetic open-loop drive of the controller
+  mimicking the engine's window cadence (rates ramping per window,
+  engine-style commit/defer), exercising the defer path the scenario
+  simulator never takes.
+
+Usage::
+
+    python scripts/harvest_replay_corpus.py [--out tests/corpus_replay]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamic_load_balance_distributeddnn_tpu.balance import replaylab  # noqa: E402
+from dynamic_load_balance_distributeddnn_tpu.balance.controller import (  # noqa: E402
+    OnlineRebalanceController,
+)
+
+# one scenario per schedule family — enough shapes to pin the decision
+# rule without bloating the repo
+CORPUS_SCENARIOS = ("sin-surge", "spike-burst", "rack-brownout", "kill-storm")
+
+
+def harvest_engine_style() -> dict:
+    """Open-loop drive mimicking the engine's window cadence, including a
+    deferred verdict (the warm-gate veto the scenario simulator never
+    issues): the corpus must pin the deferred bookkeeping path too."""
+    ctl = OnlineRebalanceController(
+        4, 256, [[0], [1], [2], [3]], bucket=8, hysteresis=0.05, margin=1.5
+    )
+    b = np.array([64, 64, 64, 64])
+    base = np.array([0.002, 0.0021, 0.0019, 0.002])
+    n_windows, spw = 24, 4
+    defer_next = True
+    for w in range(n_windows):
+        # rates ramp: worker 0 degrades 1x -> 4x across the run
+        eff = base * np.array([1.0 + 3.0 * w / n_windows, 1.0, 1.0, 1.0])
+        ctl.observe_rates(eff)
+        ctl.eval_context = {"epoch": w // 8, "window": w % 8}
+        remaining = (8 - (w % 8)) * spw
+        dec = ctl.propose(ctl.rates, b, remaining)
+        if dec.switch:
+            if defer_next:
+                # first verdict-positive switch deferred (cold executables)
+                ctl.note_deferred()
+                defer_next = False
+            else:
+                ctl.commit(dec, 0.04, epoch=w // 8, window=w % 8)
+                b = dec.candidate_batches.copy()
+        ctl.observe_wall(0.5, 0.5)
+    return replaylab.harvest(ctl, label="engine-linear-ramp")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="tests/corpus_replay")
+    args = ap.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    by_name = {s.name: s for s in replaylab.builtin_scenarios(4)}
+    corpora = []
+    for name in CORPUS_SCENARIOS:
+        r = replaylab.simulate(by_name[name], include_journal=True)
+        corpora.append(
+            {
+                "label": f"sim-{name}",
+                "config": r["config"],
+                "journal": r["journal"],
+            }
+        )
+    corpora.append(harvest_engine_style())
+    wrote = []
+    for corpus in corpora:
+        # a corpus that does not replay bit-for-bit TODAY must never be
+        # checked in — verify strict parity and invariants before writing
+        report = replaylab.replay(corpus)
+        if not report["parity"] or report["invariant_violations"]:
+            print(
+                f"REFUSING {corpus['label']}: parity={report['parity']} "
+                f"mismatches={report['mismatches'][:3]} "
+                f"violations={report['invariant_violations'][:3]}",
+                file=sys.stderr,
+            )
+            return 1
+        path = os.path.join(args.out, f"{corpus['label']}.json")
+        with open(path, "w") as fh:
+            json.dump(corpus, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        wrote.append(
+            f"{path}: {len(corpus['journal'])} entries, "
+            f"{report['recorded']['switches']} switches, "
+            f"{report['recorded']['deferred']} deferred"
+        )
+    print("\n".join(wrote))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,474 @@
+"""Trace-replay controller lab (ISSUE 19): counterfactual replay, knob
+sweeps, and scenario fuzzing — no devices required.
+
+The contract stack: the checked-in corpus (tests/corpus_replay/) replays
+through a FRESH controller reproducing every recorded verdict bit-for-bit
+(the decision rule's regression gate — a change that moves any verdict
+shows up as a corpus diff, not a silent behavior change); the invariant
+checker passes the honest corpus and catches a seeded budget-overspend
+mutation; the new injection schedules (spike/diurnal scalar, brownout/
+killstorm per-worker) are pure functions of (seed, t); the outer
+many-stream allocator journals every per-window verdict in the same shape;
+and the `graftscope replay` / `graftscope sweep` / extended `decisions`
+CLI surfaces hold their exit-code contract (0 ok, 1 drift/violations,
+2 empty-or-missing).
+"""
+
+import glob
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from dynamic_load_balance_distributeddnn_tpu.balance import replaylab
+from dynamic_load_balance_distributeddnn_tpu.balance.controller import (
+    OnlineRebalanceController,
+)
+from dynamic_load_balance_distributeddnn_tpu.faults import (
+    ScheduledStragglerInjector,
+)
+from dynamic_load_balance_distributeddnn_tpu.obs.scope_cli import (
+    main as scope_main,
+)
+from dynamic_load_balance_distributeddnn_tpu.obs.trace import (
+    configure as configure_tracer,
+    get_tracer,
+)
+
+CORPUS_DIR = pathlib.Path(__file__).resolve().parent / "corpus_replay"
+CORPUS_FILES = sorted(glob.glob(str(CORPUS_DIR / "*.json")))
+
+
+# ------------------------------------------------- corpus regression gate
+
+
+def test_corpus_is_checked_in():
+    """The gate only means something if the corpus exists: scenario sims
+    for each schedule family plus an engine-style drive with a deferral."""
+    names = {os.path.basename(p) for p in CORPUS_FILES}
+    assert len(names) >= 4
+    assert "engine-linear-ramp.json" in names
+
+
+@pytest.mark.parametrize(
+    "path", CORPUS_FILES, ids=[os.path.basename(p) for p in CORPUS_FILES]
+)
+def test_corpus_replays_bit_for_bit(path):
+    """THE tentpole gate: a fresh controller fed each entry's recorded
+    inputs must reproduce the recorded verdict sequence exactly — verdict,
+    reason, and candidate plan — and the recorded trajectory must satisfy
+    every controller invariant."""
+    corpus = replaylab.load_corpus(path)
+    report = replaylab.replay(corpus)
+    assert report["mode"] == "strict"
+    assert report["parity"], report["mismatches"][:5]
+    assert report["invariant_violations"] == []
+    assert report["replayed"]["switches"] == report["recorded"]["switches"]
+    assert report["replayed"]["deferred"] == report["recorded"]["deferred"]
+    assert not replaylab.check_invariants(corpus["config"], corpus["journal"])
+
+
+def test_invariant_checker_flags_seeded_budget_overspend():
+    """Mutation sentinel: corrupt one recorded switch so its ledger claims
+    spend beyond the regret budget — the checker must flag it (if it
+    cannot see a planted overspend, the clean corpus result means
+    nothing)."""
+    corpus = replaylab.load_corpus(CORPUS_FILES[0])
+    bad = [dict(e) for e in corpus["journal"]]
+    victim = next(e for e in bad if e.get("switch"))
+    victim["spent_s"] = (
+        victim["budget_frac"]
+        * (victim["credit_s"] + victim["predicted_win_s"])
+        + 1.0
+    )
+    violations = replaylab.check_invariants(corpus["config"], bad)
+    assert any(v["invariant"] == "switch-gate-budget" for v in violations)
+
+
+def test_invariant_checker_flags_switch_without_modeled_gain():
+    corpus = replaylab.load_corpus(CORPUS_FILES[0])
+    bad = [dict(e) for e in corpus["journal"]]
+    victim = next(e for e in bad if e.get("switch"))
+    victim["predicted_win_s"] = -0.5
+    violations = replaylab.check_invariants(corpus["config"], bad)
+    kinds = {v["invariant"] for v in violations}
+    assert "no-modeled-gain" in kinds
+
+
+def test_replay_rejects_empty_or_foreign_json(tmp_path):
+    empty = tmp_path / "nothing.json"
+    empty.write_text(json.dumps({"hello": "world"}))
+    with pytest.raises(ValueError, match="neither a replay corpus"):
+        replaylab.load_corpus(str(empty))
+    hollow = tmp_path / "hollow.json"
+    hollow.write_text(json.dumps({"config": {}, "journal": []}))
+    with pytest.raises(ValueError, match="empty"):
+        replaylab.load_corpus(str(hollow))
+
+
+# ------------------------------------------------------- counterfactuals
+
+
+def test_counterfactual_knobs_change_behavior_lawfully():
+    """Tightening every gate can only hold MORE: the counterfactual switch
+    count must not exceed the recorded one, and its journal must still be
+    invariant-clean (a counterfactual that overspends is a bug)."""
+    corpus = replaylab.load_corpus(str(CORPUS_DIR / "engine-linear-ramp.json"))
+    report = replaylab.replay(
+        corpus, knobs={"hysteresis": 0.4, "margin": 10.0}
+    )
+    assert report["mode"] == "counterfactual"
+    assert report["knobs"]["hysteresis"] == 0.4
+    assert report["replayed"]["switches"] <= report["recorded"]["switches"]
+    assert report["invariant_violations"] == []
+    # ledger trajectory is reported per evaluation
+    assert len(report["ledger"]) == report["entries"]
+
+
+def test_counterfactual_unknown_knob_is_an_error():
+    corpus = replaylab.load_corpus(CORPUS_FILES[0])
+    with pytest.raises(ValueError, match="unknown controller knob"):
+        replaylab.replay(corpus, knobs={"warp_speed": 9})
+
+
+# ----------------------------------------------------- trace-file corpora
+
+
+def test_trace_file_is_a_replayable_corpus(tmp_path):
+    """A graftscope trace alone reconstructs config + journal + outcomes:
+    the dbs_config instant carries the construction surface, and
+    dbs_switch/dbs_deferred instants re-pair with their decisions."""
+    configure_tracer("on")
+    try:
+        ctl = OnlineRebalanceController(
+            2, 64, [[0], [1]], hysteresis=0.0, margin=0.5, cost_init=0.001
+        )
+        ctl.eval_context = {"epoch": 0, "window": 0}
+        dec = ctl.propose(np.array([0.001, 0.003]), np.array([32, 32]), 100)
+        assert dec.switch
+        ctl.commit(dec, 0.002, epoch=0, window=0)
+        ctl.eval_context = {"epoch": 0, "window": 1}
+        dec2 = ctl.propose(
+            np.array([0.003, 0.001]), np.asarray(dec.candidate_batches), 50
+        )
+        assert dec2.switch
+        ctl.note_deferred()
+        live = ctl.decision_journal()
+        path = get_tracer().save(str(tmp_path / "run.trace.json"))
+    finally:
+        configure_tracer("off")
+    corpus = replaylab.load_corpus(path)
+    assert corpus["config"]["world_size"] == 2
+    assert [e["reason"] for e in corpus["journal"]] == [
+        e["reason"] for e in live
+    ]
+    assert [e.get("outcome") for e in corpus["journal"]] == [
+        "committed", "deferred"
+    ]
+    report = replaylab.replay(corpus)
+    assert report["parity"], report["mismatches"]
+    assert report["recorded"]["deferred"] == 1
+
+
+def test_journal_ring_drop_accounting(tmp_path):
+    """Ring evictions are counted, surfaced in snapshot(), and stamped on
+    the trace instants — a truncated corpus must say so."""
+    from collections import deque
+
+    configure_tracer("on")
+    try:
+        ctl = OnlineRebalanceController(2, 64, [[0], [1]])
+        ctl.journal = deque(maxlen=2)  # shrink the ring for the test
+        for k in range(4):
+            ctl.propose(np.array([0.001, 0.001 + 0.001 * k]),
+                        np.array([32, 32]), 10)
+        assert ctl.journal_dropped == 2
+        assert ctl.snapshot()["journal_dropped"] == 2
+        path = get_tracer().save(str(tmp_path / "run.trace.json"))
+    finally:
+        configure_tracer("off")
+    # the decisions header reports the truncation
+    assert scope_main(["decisions", path]) == 0
+
+
+# -------------------------------------------------- injection schedules
+
+
+def test_spike_and_diurnal_scalar_schedules():
+    inj = ScheduledStragglerInjector(
+        np.array([4.0, 1.0]), schedule="spike", period=2.0, duty=0.25
+    )
+    # inside the duty window the full factor applies; outside, none
+    assert inj.gain(0.1) == 1.0 and inj.gain(1.0) == 0.0
+    assert np.allclose(inj.factors_at(0.1), [4.0, 1.0])
+    assert np.allclose(inj.factors_at(1.0), [1.0, 1.0])
+    d = ScheduledStragglerInjector(
+        np.array([4.0, 1.0]), schedule="diurnal", period=2.0
+    )
+    gains = [d.gain(t) for t in np.linspace(0, 2.0, 17)]
+    assert all(0.0 <= g <= 1.0 for g in gains)
+    assert max(gains) > 0.9  # the plateau actually reaches high load
+
+
+def test_per_worker_schedules_are_seed_deterministic():
+    for schedule in ("brownout", "killstorm"):
+        a = ScheduledStragglerInjector(
+            np.full(6, 5.0), schedule=schedule, period=1.0, seed=7
+        )
+        b = ScheduledStragglerInjector(
+            np.full(6, 5.0), schedule=schedule, period=1.0, seed=7
+        )
+        other = ScheduledStragglerInjector(
+            np.full(6, 5.0), schedule=schedule, period=1.0, seed=8
+        )
+        ts = np.linspace(0.0, 4.0, 33)
+        va = np.stack([a.factors_at(t) for t in ts])
+        vb = np.stack([b.factors_at(t) for t in ts])
+        vo = np.stack([other.factors_at(t) for t in ts])
+        assert va.shape == (33, 6)
+        assert np.array_equal(va, vb)  # pure function of (seed, t)
+        assert not np.array_equal(va, vo)  # the seed actually matters
+        assert (va >= 1.0).all()  # factors never speed a worker up
+        # per-worker: at least one instant where workers disagree
+        assert any(len(set(row)) > 1 for row in va.tolist())
+        # scalar gain() is meaningless for per-worker schedules
+        with pytest.raises(ValueError, match="per-worker"):
+            a.gain(0.5)
+
+
+def test_scalar_schedules_gain_vec_broadcasts():
+    inj = ScheduledStragglerInjector(
+        np.array([3.0, 1.0, 1.0]), schedule="sin", period=2.0
+    )
+    v = inj.gain_vec(0.37)
+    assert v.shape == (3,)
+    assert np.allclose(v, inj.gain(0.37))
+
+
+def test_unknown_schedule_and_bad_duty_rejected():
+    with pytest.raises(ValueError, match="schedule"):
+        ScheduledStragglerInjector(np.ones(2), schedule="chaos")
+    with pytest.raises(ValueError, match="duty"):
+        ScheduledStragglerInjector(np.ones(2), schedule="spike", duty=0.0)
+
+
+def test_config_accepts_new_fault_schedules():
+    from dynamic_load_balance_distributeddnn_tpu.config import Config
+
+    for sched in ("spike", "diurnal", "brownout", "killstorm"):
+        cfg = Config(debug=True, world_size=2, batch_size=32,
+                     straggler="3,1", fault_schedule=sched)
+        assert cfg.fault_schedule == sched
+    with pytest.raises(ValueError):
+        Config(debug=True, world_size=2, batch_size=32,
+               straggler="3,1", fault_schedule="lightning")
+
+
+# ------------------------------------------------------ scenario simulate
+
+
+def test_simulate_is_deterministic_and_clean():
+    sc = next(
+        s for s in replaylab.builtin_scenarios(4) if s.name == "kill-storm"
+    )
+    a = replaylab.simulate(sc, include_journal=True)
+    b = replaylab.simulate(sc, include_journal=True)
+    assert a["journal"] == b["journal"]
+    assert a["wall_s"] == b["wall_s"]
+    assert a["invariant_violations"] == []
+    assert a["evals"] == sc.epochs * sc.windows_per_epoch
+    # the controller must actually beat never-rebalancing under a straggler
+    assert a["speedup_vs_hold"] > 1.0
+
+
+def test_simulated_journals_replay_bit_for_bit():
+    """Closed-loop sims feed the same corpus gate: synth journals are not
+    a separate dialect."""
+    for sc in replaylab.builtin_scenarios(4)[:2]:
+        r = replaylab.simulate(sc, include_journal=True)
+        rep = replaylab.replay(
+            {"label": sc.name, "config": r["config"], "journal": r["journal"]}
+        )
+        assert rep["parity"], (sc.name, rep["mismatches"][:3])
+
+
+# ------------------------------------------------------------------ sweep
+
+
+def test_sweep_ranks_and_reports():
+    scenarios = replaylab.builtin_scenarios(4)[:2]
+    knob_sets = replaylab.knob_grid("small")[:4] + replaylab.random_knobs(
+        2, seed=1
+    )
+    report = replaylab.sweep(scenarios, knob_sets)
+    assert report["candidates"] == len(knob_sets) + 1  # + default
+    scores = [r["score"] for r in report["results"]]
+    assert scores == sorted(scores, reverse=True)
+    assert report["best"]["score"] >= report["default"]["score"]
+    assert report["invariant_violations"] == 0
+    assert set(report["results"][0]["per_scenario"]) == {
+        sc.name for sc in scenarios
+    }
+
+
+def test_random_knobs_seeded_and_bounded():
+    a = replaylab.random_knobs(5, seed=3)
+    assert a == replaylab.random_knobs(5, seed=3)
+    assert a != replaylab.random_knobs(5, seed=4)
+    for k in a:
+        assert 0.02 <= k["hysteresis"] <= 0.4
+        assert 1.0 <= k["margin"] <= 8.0
+
+
+# ---------------------------------------------------------- CLI contract
+
+
+def test_cli_replay_strict_and_counterfactual(capsys):
+    assert scope_main(["replay", CORPUS_FILES[0]]) == 0
+    out = capsys.readouterr().out
+    assert "parity: OK" in out and "invariants: clean" in out
+    assert (
+        scope_main(["replay", CORPUS_FILES[0], "--margin", "9",
+                    "--hysteresis", "0.3", "--json"])
+        == 0
+    )
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["mode"] == "counterfactual"
+    assert rep["knobs"]["margin"] == 9.0
+
+
+def test_cli_replay_flags_corrupted_corpus(tmp_path, capsys):
+    """Exit 1 — not a crash, not a clean 0 — when the corpus does not
+    reproduce: the gate CI keys off the exit code."""
+    corpus = json.load(open(CORPUS_FILES[0]))
+    victim = next(e for e in corpus["journal"] if e.get("switch"))
+    victim["reason"] = "below-margin"
+    victim["switch"] = False
+    bad = tmp_path / "tampered.json"
+    bad.write_text(json.dumps(corpus))
+    assert scope_main(["replay", str(bad)]) == 1
+    assert "DRIFT" in capsys.readouterr().out
+
+
+def test_cli_replay_missing_path_is_usage_error(tmp_path, capsys):
+    assert scope_main(["replay", str(tmp_path / "nope.json")]) == 2
+
+
+def test_cli_sweep_smoke(tmp_path, capsys):
+    out_path = tmp_path / "sweep.json"
+    rc = scope_main(
+        ["sweep", "--scenarios", "sin-surge", "--grid", "small",
+         "--random", "1", "-o", str(out_path)]
+    )
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "speedup_vs_hold" in text and "default" in text
+    saved = json.loads(out_path.read_text())
+    assert saved["scenarios"] == ["sin-surge"]
+    assert scope_main(["sweep", "--scenarios", "no-such-scenario"]) == 2
+
+
+def test_cli_decisions_filters_and_csv(tmp_path, capsys):
+    configure_tracer("on")
+    try:
+        ctl = OnlineRebalanceController(
+            2, 64, [[0], [1]], hysteresis=0.0, margin=0.5, cost_init=0.001
+        )
+        ctl.eval_context = {"epoch": 0, "window": 0}
+        ctl.propose(np.array([0.001, 0.001]), np.array([32, 32]), 0)
+        ctl.eval_context = {"epoch": 2, "window": 0}
+        dec = ctl.propose(np.array([0.001, 0.003]), np.array([32, 32]), 100)
+        ctl.commit(dec, 0.002, epoch=2, window=0)
+        path = get_tracer().save(str(tmp_path / "run.trace.json"))
+    finally:
+        configure_tracer("off")
+    assert scope_main(["decisions", path, "--outcome", "committed"]) == 0
+    out = capsys.readouterr().out
+    assert "committed" in out and "no-horizon" not in out
+    assert scope_main(["decisions", path, "--since", "1", "--csv"]) == 0
+    csv_out = capsys.readouterr().out
+    assert csv_out.splitlines()[0].startswith("epoch,win,verdict")
+    assert all(
+        line.startswith("2,") for line in csv_out.splitlines()[1:]
+    )
+    # filters that match nothing are a usage error, not silent emptiness
+    assert scope_main(["decisions", path, "--since", "99"]) == 2
+    assert scope_main(["decisions", path, "--outcome", "deferred"]) == 2
+
+
+# ------------------------------------------------------ outer-loop journal
+
+
+def test_outer_allocator_journals_every_verdict(tmp_path):
+    """Satellite (a): the many-stream engine's per-window allocation solve
+    journals EVERY verdict — holds included — in the decision-journal
+    shape, mirrored as pool_decision instants and rendered by `graftscope
+    decisions`."""
+    from dynamic_load_balance_distributeddnn_tpu.runtime.scheduler import (
+        MultiStreamEngine,
+    )
+    from tests.test_scheduler import _fake_job
+
+    configure_tracer("on")
+    try:
+        eng = MultiStreamEngine(n_devices=8)
+        eng._apply_allotment = lambda js, ords: None  # no live trainers
+        slow = _fake_job("slow", wall=6.0, devices=(0, 1, 2, 3))
+        fast = _fake_job("fast", wall=2.0, devices=(4, 5, 6, 7))
+        # verdict 1: counts move 4/4 -> 6/2, gain clears the margin
+        eng._solve_and_actuate([slow, fast], membership_changed=False)
+        slow.devices, fast.devices = (0, 1, 2, 3, 4, 5), (6, 7)
+        # verdict 2: walls re-measured at the equalized fixed point (24/6
+        # == 8/2 == 4.0) -> the solve proposes the counts already in force
+        slow.wall_ema, fast.wall_ema = 4.0, 4.0
+        eng._solve_and_actuate([slow, fast], membership_changed=False)
+        # verdict 3: budget exhausted -> hold
+        eng._migrations_spent = eng.migration_budget
+        fast.wall_ema = 60.0
+        eng._solve_and_actuate([slow, fast], membership_changed=False)
+        j = eng.decision_journal()
+        assert [e["reason"] for e in j] == [
+            "migrate", "same-counts", "budget-exhausted"
+        ]
+        assert [e["outcome"] for e in j] == ["committed", "hold", "hold"]
+        assert j[0]["switch"] and not j[1]["switch"]
+        assert j[0]["proposed_counts"] == {"slow": 6, "fast": 2}
+        assert j[0]["modeled_gain"] is not None
+        assert j[2]["wall_emas"]["fast"] == 60.0
+        snap = eng.snapshot()
+        assert snap["evals"] == 3 and snap["actuations"] == 1
+        assert snap["decisions"] == 3 and snap["journal_dropped"] == 0
+        assert snap["last_decision"]["reason"] == "budget-exhausted"
+        assert "journal" in eng.snapshot(include_journal=True)
+        # the registry surfaces the outer journal like the inner one
+        reg_snap = eng.obs.snapshot()
+        assert reg_snap["scheduler"]["evals"] == 3
+        evs = [
+            e for e in get_tracer().events()
+            if e[1] == "decision" and e[0] == "pool_decision"
+        ]
+        assert len(evs) == 3
+        path = get_tracer().save(str(tmp_path / "pool.trace.json"))
+    finally:
+        configure_tracer("off")
+    # graftscope decisions renders MIGRATE/hold rows for the outer journal
+    assert scope_main(["decisions", path]) == 0
+
+
+def test_outer_allocator_unmeasured_hold_is_journaled():
+    from dynamic_load_balance_distributeddnn_tpu.runtime.scheduler import (
+        MultiStreamEngine,
+    )
+    from tests.test_scheduler import _fake_job
+
+    eng = MultiStreamEngine(n_devices=8)
+    eng._apply_allotment = lambda js, ords: None
+    known = _fake_job("known", wall=2.0, devices=(0, 1, 2, 3))
+    fresh = _fake_job("fresh", devices=(4, 5, 6, 7))  # no wall yet
+    eng._solve_and_actuate([known, fresh], membership_changed=False)
+    j = eng.decision_journal()
+    assert len(j) == 1
+    assert j[0]["reason"] in ("unmeasured-hold", "same-counts")
+    assert j[0]["outcome"] == "hold"

@@ -448,11 +448,16 @@ def test_controller_journals_every_verdict_and_traces_them():
         snap = ctl.snapshot()
         assert snap["decisions"] == 2
         assert snap["last_decision"]["reason"] == "switch"
-        # trace instants: one decision per evaluation + the commit marker
+        # trace instants: the one-time construction surface (ISSUE 19 —
+        # a spool alone is a replayable corpus), one decision per
+        # evaluation, + the commit marker
         evs = [e for e in get_tracer().events() if e[1] == "decision"]
         assert [e[0] for e in evs] == [
-            "dbs_decision", "dbs_decision", "dbs_switch"
+            "dbs_config", "dbs_decision", "dbs_decision", "dbs_switch"
         ]
+        cfg_args = evs[0][-1]
+        assert cfg_args["world_size"] == 2 and cfg_args["global_batch"] == 64
+        assert cfg_args == ctl.journal_config()
     finally:
         configure_tracer("off")
 
